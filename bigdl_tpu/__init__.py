@@ -1,0 +1,27 @@
+"""bigdl_tpu — a TPU-native deep-learning framework with BigDL's capabilities.
+
+A from-scratch re-design of the BigDL (Intel Analytics, v0.x) training stack
+for TPU hardware:
+
+- the Torch-style ``Tensor``/MKL layer becomes jax.numpy + XLA fusion,
+- hand-written per-layer backward passes become ``jax.grad`` over pure
+  module functions,
+- the Spark BlockManager parameter AllReduce becomes XLA collectives
+  (``psum`` / ``reduce_scatter`` / ``all_gather``) over ICI inside a
+  ``shard_map``-compiled train step,
+- the Spark driver/executor topology becomes JAX multi-host SPMD over a
+  ``jax.sharding.Mesh``.
+
+Reference layer map: see SURVEY.md (reference at /root/reference,
+``DL/`` = spark/dl/src/main/scala/com/intel/analytics/bigdl/).
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.engine import Engine
+from bigdl_tpu import nn
+from bigdl_tpu import optim
+from bigdl_tpu import dataset
+from bigdl_tpu import parallel
+from bigdl_tpu import models
+from bigdl_tpu import utils

@@ -1,0 +1,98 @@
+"""Engine — runtime/topology bookkeeping.
+
+TPU-native analog of the reference's ``DL/utils/Engine.scala`` (553 LoC):
+there, ``Engine.init`` parses Spark conf, sizes thread pools and records
+node/core counts; every layer then calls ``Engine.default.invokeAndWait``
+for intra-node parallelism.
+
+On TPU none of that exists: intra-chip parallelism is XLA's job and
+inter-chip parallelism is a ``jax.sharding.Mesh``.  What remains of the
+Engine's role is topology bookkeeping — how many devices/hosts there are,
+which mesh the optimizers should shard over — plus the ``bigdl.*``-style
+config surface (reference: ``Engine.scala:45-47,190-215``), centralized
+here as documented attributes instead of scattered system properties.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass
+class _EngineState:
+    initialized: bool = False
+    mesh: Optional[Mesh] = None
+    seed: int = 1
+    # reference knob: bigdl.failure.retryTimes (DistriOptimizer retry loop)
+    failure_retry_times: int = 5
+
+
+class Engine:
+    """Process-wide runtime state.  ``Engine.init()`` is idempotent.
+
+    Reference parity: ``Engine.init`` (``DL/utils/Engine.scala:105-118``),
+    ``Engine.nodeNumber()/coreNumber()`` → :meth:`node_number` /
+    :meth:`core_number` report JAX process/device counts instead of Spark
+    executors/cores.
+    """
+
+    _state = _EngineState()
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    def init(cls, seed: int = 1) -> None:
+        cls._state.initialized = True
+        cls._state.seed = seed
+
+    @classmethod
+    def is_initialized(cls) -> bool:
+        return cls._state.initialized
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._state = _EngineState()
+
+    # -- topology ----------------------------------------------------------
+    @classmethod
+    def node_number(cls) -> int:
+        """Number of hosts (reference: Spark executor count)."""
+        return jax.process_count()
+
+    @classmethod
+    def core_number(cls) -> int:
+        """Devices per host (reference: cores per executor)."""
+        return jax.local_device_count()
+
+    @classmethod
+    def device_count(cls) -> int:
+        return jax.device_count()
+
+    # -- mesh --------------------------------------------------------------
+    @classmethod
+    def set_mesh(cls, mesh: Mesh) -> None:
+        cls._state.mesh = mesh
+
+    @classmethod
+    def get_mesh(cls) -> Mesh:
+        """The mesh distributed optimizers shard over.
+
+        Defaults to a 1-D data-parallel mesh over all devices — the direct
+        analog of the reference's one-replica-per-core data parallelism
+        (``DistriOptimizer.scala:136-139``), minus the per-core replication
+        (the batch is sharded over devices instead).
+        """
+        if cls._state.mesh is None:
+            devs = np.array(jax.devices())
+            cls._state.mesh = Mesh(devs, axis_names=("data",))
+        return cls._state.mesh
+
+    # -- config ------------------------------------------------------------
+    @classmethod
+    def seed(cls) -> int:
+        return cls._state.seed

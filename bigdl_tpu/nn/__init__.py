@@ -1,0 +1,44 @@
+"""bigdl_tpu.nn — module system, layers, criterions.
+
+TPU-native re-design of ``DL/nn/`` (reference: 413 files, 67,616 LoC).
+See ``module.py`` for the functional contract that replaces
+``AbstractModule``'s mutable forward/backward.
+"""
+
+from bigdl_tpu.nn.module import (
+    Module, Container, Sequential, Concat, ConcatTable, ParallelTable,
+    Identity, Echo, Lambda,
+)
+from bigdl_tpu.nn.initialization import (
+    InitializationMethod, Zeros, Ones, ConstInitMethod, Xavier, MsraFiller,
+    RandomUniform, RandomNormal, BilinearFiller,
+)
+from bigdl_tpu.nn.layers import (
+    Linear, SpatialConvolution, SpatialFullConvolution, SpatialMaxPooling,
+    SpatialAveragePooling, SpatialBatchNormalization, BatchNormalization,
+    Dropout, LookupTable, SpatialCrossMapLRN, Normalize, CMul, CAdd,
+    TemporalConvolution,
+)
+from bigdl_tpu.nn.activations import (
+    ReLU, ReLU6, Tanh, Sigmoid, SoftMax, LogSoftMax, SoftPlus, SoftSign,
+    ELU, LeakyReLU, HardTanh, HardSigmoid, GELU, SiLU, PReLU, RReLU, SReLU,
+    Threshold,
+)
+from bigdl_tpu.nn.shape_ops import (
+    Reshape, View, Flatten, Squeeze, Unsqueeze, Transpose, Contiguous,
+    Narrow, Select, Index, Padding, SpatialZeroPadding, JoinTable,
+    SplitTable, CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable,
+    CMinTable, FlattenTable, SelectTable, MulConstant, AddConstant, Power,
+    Sqrt, Square, Abs, Exp, Log, Clamp, Mean, Sum, Max, Min, Replicate,
+    Pack, Scale, Masking,
+)
+from bigdl_tpu.nn.criterion import (
+    Criterion, ClassNLLCriterion, CrossEntropyCriterion, MSECriterion,
+    AbsCriterion, BCECriterion, BCEWithLogitsCriterion, SmoothL1Criterion,
+    DistKLDivCriterion, KLDCriterion, GaussianCriterion, MarginCriterion,
+    MarginRankingCriterion, CosineEmbeddingCriterion,
+    HingeEmbeddingCriterion, SoftMarginCriterion, L1Cost,
+    DiceCoefficientCriterion, MultiLabelSoftMarginCriterion, MultiCriterion,
+    ParallelCriterion, TimeDistributedCriterion, PGCriterion,
+    MultiLabelMarginCriterion, SoftmaxWithCriterion,
+)

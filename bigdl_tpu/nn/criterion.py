@@ -1,0 +1,410 @@
+"""Criterions (loss functions).
+
+Reference: ``DL/nn/AbstractCriterion`` + the ~40 criterion files
+(``ClassNLLCriterion``, ``MSECriterion``, ``BCECriterion``,
+``SmoothL1Criterion``, ``DistKLDivCriterion``, ``MarginCriterion``, …).
+
+Functional contract: ``apply(input, target) -> scalar`` (pure; jit/grad
+compatible).  The reference's hand-written ``updateGradInput`` is replaced
+by ``jax.grad`` of the loss.  Class targets are 0-based integer arrays
+(reference/Torch is 1-based).
+
+``size_average=True`` (the reference default) averages over the batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class Criterion:
+    """Base class.  Eager convenience mirrors AbstractCriterion:
+    ``forward(input, target)`` returns the loss; ``backward`` returns
+    d loss/d input via jax.grad."""
+
+    size_average: bool = True
+
+    def apply(self, input, target):
+        raise NotImplementedError
+
+    def forward(self, input, target):
+        self.output = self.apply(input, target)
+        return self.output
+
+    def __call__(self, input, target):
+        return self.forward(input, target)
+
+    def backward(self, input, target):
+        self.grad_input = jax.grad(lambda x: self.apply(x, target))(input)
+        return self.grad_input
+
+    def _reduce(self, losses):
+        """Batch reduction policy: mean when ``size_average`` (the reference
+        default), else sum."""
+        return jnp.mean(losses) if self.size_average else jnp.sum(losses)
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probabilities (pair with LogSoftMax;
+    reference ``ClassNLLCriterion.scala``).  Supports class weights and
+    padding via ``ignore_index`` (maps the reference's logProbAsInput /
+    paddingValue behaviors)."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None,
+                 size_average: bool = True, logits: bool = False,
+                 ignore_index: int = -100):
+        self.weights = weights
+        self.size_average = size_average
+        self.logits = logits  # if True, input is raw logits, not log-probs
+        self.ignore_index = ignore_index
+
+    def apply(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=-1) if self.logits else input
+        t = target.astype(jnp.int32)
+        valid = (t != self.ignore_index)
+        t_safe = jnp.where(valid, t, 0)
+        picked = jnp.take_along_axis(logp, t_safe[..., None], axis=-1)[..., 0]
+        w = jnp.ones_like(picked)
+        if self.weights is not None:
+            w = jnp.take(self.weights, t_safe)
+        w = jnp.where(valid, w, 0.0)
+        total = -jnp.sum(w * picked)
+        if self.size_average:
+            return total / jnp.maximum(jnp.sum(w), 1e-8)
+        return total
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (reference ``CrossEntropyCriterion.scala``)."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None,
+                 size_average: bool = True):
+        self._nll = ClassNLLCriterion(weights, size_average, logits=True)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        return self._nll.apply(input, target)
+
+
+class MSECriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        d = (input - target) ** 2
+        return self._reduce(d)
+
+
+class AbsCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        d = jnp.abs(input - target)
+        return self._reduce(d)
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy on probabilities (reference
+    ``BCECriterion.scala``; clamps like the reference's eps)."""
+
+    def __init__(self, weights: Optional[jnp.ndarray] = None,
+                 size_average: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        eps = 1e-12
+        x = jnp.clip(input, eps, 1.0 - eps)
+        l = -(target * jnp.log(x) + (1.0 - target) * jnp.log1p(-x))
+        if self.weights is not None:
+            l = l * self.weights
+        return self._reduce(l)
+
+
+class BCEWithLogitsCriterion(Criterion):
+    """Numerically-stable BCE on logits (not separate in the reference;
+    included because it is the stable form on TPU bf16)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.maximum(input, 0) - input * target + jnp.log1p(
+            jnp.exp(-jnp.abs(input)))
+        return self._reduce(l)
+
+
+class SmoothL1Criterion(Criterion):
+    """Huber loss with delta 1 (reference ``SmoothL1Criterion.scala``)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        d = jnp.abs(input - target)
+        l = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return self._reduce(l)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || input) with input = log-probs (reference
+    ``DistKLDivCriterion.scala``)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-12))
+                                            - input), 0.0)
+        # reference averages over batch dim (sizeAverage), else sums all
+        if self.size_average:
+            return jnp.sum(l) / input.shape[0]
+        return jnp.sum(l)
+
+
+class KLDCriterion(Criterion):
+    """VAE latent KL: input=(mean, log_var), target unused
+    (reference ``KLDCriterion.scala``)."""
+
+    def apply(self, input, target=None):
+        mean, log_var = input
+        kl = 0.5 * jnp.sum(mean ** 2 + jnp.exp(log_var) - 1.0 - log_var,
+                           axis=-1)
+        return jnp.mean(kl)
+
+
+class GaussianCriterion(Criterion):
+    """Negative log-likelihood of a diagonal Gaussian: input=(mean,log_var)
+    (reference ``GaussianCriterion.scala``)."""
+
+    def apply(self, input, target):
+        mean, log_var = input
+        nll = 0.5 * (jnp.log(2 * jnp.pi) + log_var
+                     + (target - mean) ** 2 / jnp.exp(log_var))
+        return jnp.sum(nll) / target.shape[0]
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss; target in {-1, 1} (reference ``MarginCriterion.scala``;
+    squared=False default)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def apply(self, input, target):
+        l = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            l = l * l
+        return self._reduce(l)
+
+
+class MarginRankingCriterion(Criterion):
+    """input=(x1, x2); target ±1 (reference ``MarginRankingCriterion.scala``)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x1, x2 = input
+        l = jnp.maximum(0.0, -target * (x1 - x2) + self.margin)
+        return self._reduce(l)
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """input=(x1, x2); target 1 → pull together, -1 → push apart
+    (reference ``CosineEmbeddingCriterion.scala``)."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x1, x2 = input
+        cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        l = jnp.where(target > 0, 1.0 - cos,
+                      jnp.maximum(0.0, cos - self.margin))
+        return self._reduce(l)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.where(target > 0, input,
+                      jnp.maximum(0.0, self.margin - input))
+        return self._reduce(l)
+
+
+class SoftMarginCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.log1p(jnp.exp(-input * target))
+        return self._reduce(l)
+
+
+class L1Cost(Criterion):
+    """(reference ``L1Cost.scala``) sum |x|; target ignored."""
+
+    def apply(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - dice overlap (reference ``DiceCoefficientCriterion.scala``)."""
+
+    def __init__(self, epsilon: float = 1.0):
+        self.epsilon = epsilon
+
+    def apply(self, input, target):
+        axes = tuple(range(1, input.ndim))
+        num = 2.0 * jnp.sum(input * target, axes) + self.epsilon
+        den = jnp.sum(input, axes) + jnp.sum(target, axes) + self.epsilon
+        return jnp.mean(1.0 - num / den)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = -(target * jax.nn.log_sigmoid(input)
+              + (1 - target) * jax.nn.log_sigmoid(-input))
+        return self._reduce(l)
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same (input, target)
+    (reference ``MultiCriterion.scala``)."""
+
+    def __init__(self):
+        self.criterions: list[tuple[Criterion, float]] = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append((criterion, weight))
+        return self
+
+    def apply(self, input, target):
+        return sum(w * c.apply(input, target) for c, w in self.criterions)
+
+
+class ParallelCriterion(Criterion):
+    """i-th criterion on (input[i], target[i]) (reference
+    ``ParallelCriterion.scala``)."""
+
+    def __init__(self, repeat_target: bool = False):
+        self.criterions: list[tuple[Criterion, float]] = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append((criterion, weight))
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        for i, (c, w) in enumerate(self.criterions):
+            t = target if self.repeat_target else target[i]
+            total = total + w * c.apply(input[i], t)
+        return total
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of (N, T, ...) input
+    (reference ``TimeDistributedCriterion.scala``)."""
+
+    def __init__(self, critrn: Criterion, size_average: bool = False):
+        self.critrn = critrn
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        """Reference semantics: per-step loss is summed over timesteps, then
+        divided by T iff ``size_average``.  The inner criterion reduces over
+        the batch; flattening (N,T,...) → (N*T,...) means a mean-reducing
+        inner criterion yields sum_t(loss_t)/T already, and a sum-reducing
+        one yields sum_t(loss_t)."""
+        T = input.shape[1]
+        x = input.reshape((-1,) + input.shape[2:])
+        t = target.reshape((-1,) + target.shape[2:])
+        loss = self.critrn.apply(x, t)
+        inner_mean = getattr(self.critrn, "size_average", True)
+        if inner_mean:
+            return loss if self.size_average else loss * T
+        return loss / T if self.size_average else loss
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion: -sum(log(p) * reward)
+    (reference ``PGCriterion.scala``)."""
+
+    def __init__(self, size_average: bool = False):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = -jnp.log(jnp.maximum(input, 1e-12)) * target
+        return self._reduce(l)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-class multi-label hinge (reference
+    ``MultiLabelMarginCriterion.scala``).  Targets: per-row 0-based class
+    indices padded with -1."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        t = target.astype(jnp.int32)
+        valid = (t >= 0)
+        t_safe = jnp.where(valid, t, 0)
+        tgt_scores = jnp.take_along_axis(input, t_safe, axis=-1)
+        # for each (sample, class j not in targets, target k): max(0, 1 - (x[k]-x[j]))
+        # scatter-add then >0 so a padding slot (t_safe=0, valid=False) can't
+        # clobber a genuine class-0 target at the same index
+        hits = jnp.zeros_like(input, dtype=jnp.int32)
+        hits = jax.vmap(lambda m, idx, v: m.at[idx].add(v))(
+            hits, t_safe, valid.astype(jnp.int32))
+        is_target = hits > 0
+        margins = 1.0 - (tgt_scores[:, :, None] - input[:, None, :])
+        margins = jnp.where(valid[:, :, None] & ~is_target[:, None, :],
+                            jnp.maximum(margins, 0.0), 0.0)
+        l = jnp.sum(margins, axis=(1, 2)) / input.shape[-1]
+        return self._reduce(l)
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe-style softmax loss on NCHW maps (reference
+    ``SoftmaxWithCriterion.scala``)."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID"):
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def apply(self, input, target):
+        # input (N, C, H, W), target (N, H, W) int
+        logp = jax.nn.log_softmax(input, axis=1)
+        t = target.astype(jnp.int32)
+        valid = jnp.ones_like(t, dtype=bool) if self.ignore_label is None \
+            else (t != self.ignore_label)
+        t_safe = jnp.where(valid, t, 0)
+        picked = jnp.take_along_axis(logp, t_safe[:, None], axis=1)[:, 0]
+        total = -jnp.sum(jnp.where(valid, picked, 0.0))
+        if self.normalize_mode == "VALID":
+            return total / jnp.maximum(jnp.sum(valid), 1)
+        elif self.normalize_mode == "BATCH_SIZE":
+            return total / input.shape[0]
+        return total

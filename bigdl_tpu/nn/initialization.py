@@ -1,0 +1,96 @@
+"""Weight initialization methods (reference ``DL/nn/InitializationMethod.scala``).
+
+Each method is ``init(rng, shape, fan_in, fan_out) -> array``.  Layers are
+"Initializable": they take ``weight_init`` / ``bias_init`` kwargs mirroring
+the reference's ``setInitMethod(weightInitMethod, biasInitMethod)``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class InitializationMethod:
+    def init(self, rng, shape, fan_in, fan_out):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def init(self, rng, shape, fan_in, fan_out):
+        return jnp.zeros(shape, jnp.float32)
+
+
+class Ones(InitializationMethod):
+    def init(self, rng, shape, fan_in, fan_out):
+        return jnp.ones(shape, jnp.float32)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def init(self, rng, shape, fan_in, fan_out):
+        return jnp.full(shape, self.value, jnp.float32)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform: U(-a, a), a = sqrt(6/(fan_in+fan_out))
+    (reference ``InitializationMethod.scala`` Xavier)."""
+
+    def init(self, rng, shape, fan_in, fan_out):
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, jnp.float32, -a, a)
+
+
+class MsraFiller(InitializationMethod):
+    """Kaiming/He normal: N(0, sqrt(2/fan)) (reference MsraFiller;
+    ``varianceNormAverage=false`` → fan_in)."""
+
+    def __init__(self, variance_norm_average: bool = False):
+        self.variance_norm_average = variance_norm_average
+
+    def init(self, rng, shape, fan_in, fan_out):
+        fan = (fan_in + fan_out) / 2.0 if self.variance_norm_average else fan_in
+        std = math.sqrt(2.0 / fan)
+        return std * jax.random.normal(rng, shape, jnp.float32)
+
+
+class RandomUniform(InitializationMethod):
+    """U(lower, upper); with no bounds, the Torch default U(-1/sqrt(fan_in),
+    1/sqrt(fan_in)) used by Linear/SpatialConvolution in the reference."""
+
+    def __init__(self, lower: float | None = None, upper: float | None = None):
+        self.lower, self.upper = lower, upper
+
+    def init(self, rng, shape, fan_in, fan_out):
+        if self.lower is None:
+            b = 1.0 / math.sqrt(max(fan_in, 1))
+            lo, hi = -b, b
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, jnp.float32, lo, hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def init(self, rng, shape, fan_in, fan_out):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, jnp.float32)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear-upsampling kernel init for full (transposed) convolutions
+    (reference BilinearFiller; weight shape (..., kh, kw))."""
+
+    def init(self, rng, shape, fan_in, fan_out):
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+        c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ys = jnp.arange(kh)[:, None]
+        xs = jnp.arange(kw)[None, :]
+        filt = (1 - jnp.abs(ys / f_h - c_h)) * (1 - jnp.abs(xs / f_w - c_w))
+        return jnp.broadcast_to(filt, shape).astype(jnp.float32)

@@ -1,0 +1,484 @@
+"""Core parameterized layers.
+
+Reference: the ~210 per-file layers at ``DL/nn/`` top level.  Kernels that
+the reference routes to MKL JNI (gemm in ``Linear.scala:92-157``, im2col+gemm
+in ``SpatialConvolution.scala:612-646``) are a single jnp/lax op here — XLA
+lowers them to the MXU, which is the whole point of the TPU-native design.
+
+Conventions (TPU-first, documented divergences from the reference):
+- dims are 0-based with batch at axis 0 (reference/Torch is 1-based);
+- conv layout defaults to NCHW for API parity but NHWC is supported via
+  ``format=`` and is preferred on TPU;
+- class targets are 0-based (reference/Torch 1-based).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+
+
+class Linear(Module):
+    """Affine layer y = xW^T + b (reference ``DL/nn/Linear.scala:44``;
+    its MKL gemm call sites `:92,107,125-157` become one jnp.dot → MXU)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+        self.bias_init = bias_init or RandomUniform()
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        fan_in, fan_out = self.input_size, self.output_size
+        params = {"weight": self.weight_init.init(
+            k_w, (self.output_size, self.input_size), fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(k_b, (self.output_size,),
+                                                 fan_in, fan_out)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y = jnp.dot(input, params["weight"].T)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+
+def _conv_dims(fmt: str):
+    if fmt == "NCHW":
+        return ("NCHW", "OIHW", "NCHW")
+    elif fmt == "NHWC":
+        return ("NHWC", "HWIO", "NHWC")
+    raise ValueError(f"unknown format {fmt}")
+
+
+class SpatialConvolution(Module):
+    """2-D convolution (reference ``DL/nn/SpatialConvolution.scala:54``:
+    im2col + MKL gemm with per-sample threading — here one
+    ``lax.conv_general_dilated``, tiled onto the MXU by XLA).
+
+    Weight shape OIHW: (n_output, n_input/group, kh, kw)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, with_bias: bool = True,
+                 dilation_w: int = 1, dilation_h: int = 1,
+                 format: str = "NCHW",
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.dilation = (dilation_h, dilation_w)
+        self.format = format
+        self.weight_init = weight_init or RandomUniform()
+        self.bias_init = bias_init or RandomUniform()
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane // self.n_group * kh * kw
+        fan_out = self.n_output_plane // self.n_group * kh * kw
+        w_shape = (self.n_output_plane, self.n_input_plane // self.n_group, kh, kw)
+        params = {"weight": self.weight_init.init(k_w, w_shape, fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(k_b, (self.n_output_plane,),
+                                                 fan_in, fan_out)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w = params["weight"]
+        if self.format == "NHWC":
+            w = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+        # SAME_LOWER not needed: reference pad=-1 means "same"; handle it
+        ph, pw = self.pad
+        if ph == -1 or pw == -1:
+            padding = "SAME"
+        else:
+            padding = ((ph, ph), (pw, pw))
+        y = lax.conv_general_dilated(
+            input, w,
+            window_strides=self.stride,
+            padding=padding,
+            rhs_dilation=self.dilation,
+            dimension_numbers=_conv_dims(self.format),
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            b = params["bias"]
+            y = y + (b[None, :, None, None] if self.format == "NCHW"
+                     else b[None, None, None, :])
+        return y, state
+
+
+class SpatialFullConvolution(Module):
+    """Transposed 2-D convolution (reference ``SpatialFullConvolution.scala``;
+    deconvolution for FCN/segmentation heads)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 adj_w: int = 0, adj_h: int = 0,
+                 with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.adj = (adj_h, adj_w)
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+        self.bias_init = bias_init or RandomUniform()
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane * kh * kw
+        fan_out = self.n_output_plane * kh * kw
+        w_shape = (self.n_input_plane, self.n_output_plane, kh, kw)  # IOHW
+        params = {"weight": self.weight_init.init(k_w, w_shape, fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(k_b, (self.n_output_plane,),
+                                                 fan_in, fan_out)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        ah, aw = self.adj
+        # transposed conv as a fractionally-strided direct conv: dilate the
+        # input by the stride, convolve with the spatially-flipped kernel
+        # (IOHW -> OIHW with O = n_output_plane).
+        # output size = (in-1)*stride - 2*pad + kernel + adj
+        w = jnp.transpose(jnp.flip(params["weight"], axis=(2, 3)), (1, 0, 2, 3))
+        y = lax.conv_general_dilated(
+            input, w,
+            window_strides=(1, 1),
+            padding=((kh - 1 - ph, kh - 1 - ph + ah),
+                     (kw - 1 - pw, kw - 1 - pw + aw)),
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y, state
+
+
+class _Pool2D(Module):
+    def __init__(self, kernel_w: int, kernel_h: int,
+                 stride_w: Optional[int] = None, stride_h: Optional[int] = None,
+                 pad_w: int = 0, pad_h: int = 0,
+                 ceil_mode: bool = False, format: str = "NCHW",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h or kernel_h, stride_w or kernel_w)
+        self.pad = (pad_h, pad_w)
+        self.ceil_mode = ceil_mode
+        self.format = format
+
+    def _window(self, input_shape):
+        spatial = (input_shape[2], input_shape[3]) if self.format == "NCHW" \
+            else (input_shape[1], input_shape[2])
+        hw_pads = tuple(
+            (self.pad[i], self.pad[i] + self._extra(i, spatial[i]))
+            for i in (0, 1))
+        if self.format == "NCHW":
+            dims = (1, 1) + self.kernel
+            strides = (1, 1) + self.stride
+            pads = ((0, 0), (0, 0)) + hw_pads
+        else:
+            dims = (1,) + self.kernel + (1,)
+            strides = (1,) + self.stride + (1,)
+            pads = ((0, 0),) + hw_pads + ((0, 0),)
+        return dims, strides, pads
+
+    def _extra(self, i, size):
+        """Trailing pad beyond ``pad[i]`` implementing Torch/BigDL ceil mode:
+        keep the last partial window, but drop a window whose *start* lies
+        beyond input+pad ((out-1)*stride >= size+pad — reference
+        SpatialMaxPooling ceil/floor modes)."""
+        k, s, p = self.kernel[i], self.stride[i], self.pad[i]
+        if self.ceil_mode:
+            out = -(-(size + 2 * p - k) // s) + 1  # ceil div
+            if (out - 1) * s >= size + p:
+                out -= 1
+        else:
+            out = (size + 2 * p - k) // s + 1
+        return max(0, (out - 1) * s + k - size - 2 * p)
+
+
+class SpatialMaxPooling(_Pool2D):
+    """Max pooling (reference ``SpatialMaxPooling.scala``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        dims, strides, pads = self._window(input.shape)
+        y = lax.reduce_window(input, -jnp.inf, lax.max, dims, strides, pads)
+        return y, state
+
+
+class SpatialAveragePooling(_Pool2D):
+    """Average pooling (reference ``SpatialAveragePooling.scala``;
+    ``count_include_pad`` matches its countIncludePad=true default)."""
+
+    def __init__(self, *args, count_include_pad: bool = True, **kw):
+        super().__init__(*args, **kw)
+        self.count_include_pad = count_include_pad
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        dims, strides, pads = self._window(input.shape)
+        summed = lax.reduce_window(input, 0.0, lax.add, dims, strides, pads)
+        if self.count_include_pad:
+            y = summed / (self.kernel[0] * self.kernel[1])
+        else:
+            ones = jnp.ones_like(input)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+            y = summed / jnp.maximum(counts, 1.0)
+        return y, state
+
+
+class SpatialBatchNormalization(Module):
+    """BatchNorm over NCHW (reference ``SpatialBatchNormalization.scala``;
+    running stats use torch momentum semantics:
+    running = (1-momentum)*running + momentum*batch, momentum default 0.1)."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, format: str = "NCHW",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.format = format
+        self._axes = (0, 2, 3) if format == "NCHW" else (0, 1, 2)
+
+    def init(self, rng):
+        params = {}
+        if self.affine:
+            params = {"weight": jnp.ones((self.n_output,), jnp.float32),
+                      "bias": jnp.zeros((self.n_output,), jnp.float32)}
+        state = {"running_mean": jnp.zeros((self.n_output,), jnp.float32),
+                 "running_var": jnp.ones((self.n_output,), jnp.float32)}
+        return params, state
+
+    def _reshape(self, v, ndim):
+        shape = [1] * ndim
+        shape[1 if self.format == "NCHW" else -1] = self.n_output
+        return v.reshape(shape)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ndim = input.ndim
+        axes = self._axes if ndim == 4 else (0,)
+        if training:
+            mean = jnp.mean(input, axis=axes)
+            var = jnp.var(input, axis=axes)
+            n = input.size / self.n_output
+            unbiased = var * n / max(n - 1, 1)
+            m = self.momentum
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (input - self._reshape(mean, ndim)) * self._reshape(inv, ndim)
+        if self.affine:
+            y = y * self._reshape(params["weight"], ndim) \
+                + self._reshape(params["bias"], ndim)
+        return y, new_state
+
+
+class BatchNormalization(SpatialBatchNormalization):
+    """1-D BatchNorm over (N, C) (reference ``BatchNormalization.scala``)."""
+    pass
+
+
+class Dropout(Module):
+    """Inverted dropout (reference ``Dropout.scala``: scales by 1/(1-p) in
+    train, identity in eval)."""
+
+    def __init__(self, init_p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = init_p
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return input, state
+        if rng is None:
+            raise ValueError("Dropout in training mode needs an rng")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, input.shape)
+        return jnp.where(mask, input / keep, 0.0), state
+
+
+class LookupTable(Module):
+    """Embedding lookup (reference ``LookupTable.scala``).  Indices are
+    0-based here (reference is 1-based Torch).  ``padding_value`` rows are
+    zeroed like the reference's paddingValue."""
+
+    def __init__(self, n_index: int, n_output: int,
+                 padding_value: Optional[int] = None,
+                 max_norm: Optional[float] = None,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        from bigdl_tpu.nn.initialization import RandomNormal
+        self.weight_init = weight_init or RandomNormal(0.0, 1.0)
+
+    def init(self, rng):
+        w = self.weight_init.init(rng, (self.n_index, self.n_output),
+                                  self.n_index, self.n_output)
+        if self.padding_value is not None:
+            w = w.at[self.padding_value].set(0.0)
+        return {"weight": w}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w = params["weight"]
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(w, axis=1, keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-7))
+        idx = input.astype(jnp.int32)
+        return jnp.take(w, idx, axis=0), state
+
+
+class SpatialCrossMapLRN(Module):
+    """Local response normalization across channels
+    (reference ``SpatialCrossMapLRN.scala``; AlexNet/Inception-v1 era)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        # input NCHW; sum x^2 over a window of `size` channels
+        sq = input * input
+        half = (self.size - 1) // 2
+        extra = self.size - 1 - half
+        acc = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, extra), (0, 0), (0, 0)))
+        denom = jnp.power(self.k + (self.alpha / self.size) * acc, self.beta)
+        return input / denom, state
+
+
+class Normalize(Module):
+    """Lp-normalize along dim 1 (reference ``Normalize.scala``)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.p, self.eps = p, eps
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(input * input, axis=1, keepdims=True))
+        else:
+            norm = jnp.power(jnp.sum(jnp.power(jnp.abs(input), self.p),
+                                     axis=1, keepdims=True), 1.0 / self.p)
+        return input / (norm + self.eps), state
+
+
+class CMul(Module):
+    """Learnable per-element scale, broadcast over batch
+    (reference ``CMul.scala``)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def init(self, rng):
+        fan = int(jnp.prod(jnp.array(self.size)))
+        w = RandomUniform().init(rng, self.size, fan, fan)
+        return {"weight": w}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * params["weight"], state
+
+
+class CAdd(Module):
+    """Learnable per-element bias (reference ``CAdd.scala``)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def init(self, rng):
+        fan = int(jnp.prod(jnp.array(self.size)))
+        b = RandomUniform().init(rng, self.size, fan, fan)
+        return {"bias": b}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input + params["bias"], state
+
+
+class TemporalConvolution(Module):
+    """1-D convolution over (N, T, C_in) (reference
+    ``TemporalConvolution.scala``)."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1,
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.weight_init = weight_init or RandomUniform()
+        self.bias_init = bias_init or RandomUniform()
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        fan_in = self.input_frame_size * self.kernel_w
+        fan_out = self.output_frame_size * self.kernel_w
+        w = self.weight_init.init(
+            k_w, (self.output_frame_size, self.input_frame_size, self.kernel_w),
+            fan_in, fan_out)
+        b = self.bias_init.init(k_b, (self.output_frame_size,), fan_in, fan_out)
+        return {"weight": w, "bias": b}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        # (N, T, C) -> conv via NWC layout
+        y = lax.conv_general_dilated(
+            input, jnp.transpose(params["weight"], (2, 1, 0)),  # OIW->WIO
+            window_strides=(self.stride_w,), padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        return y + params["bias"], state

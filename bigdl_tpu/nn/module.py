@@ -1,0 +1,279 @@
+"""Module system — the TPU-native replacement for BigDL's nn contract.
+
+Reference: ``DL/nn/abstractnn/AbstractModule.scala:58`` defines a *mutable*
+contract — ``updateOutput`` writes ``this.output``, ``updateGradInput`` /
+``accGradParameters`` hand-write every backward pass, and layers carry their
+weights as fields.
+
+That design cannot live under XLA: everything inside ``jit`` must be a pure
+function of its inputs.  So the contract here is *functional*:
+
+- a :class:`Module` is an immutable **descriptor** (hyper-parameters only);
+- ``init(rng)`` returns ``(params, state)`` pytrees — ``params`` is the
+  trainable pytree (reference: ``parameters()`` weight arrays,
+  ``AbstractModule.scala:337``), ``state`` the non-trainable running
+  statistics (BatchNorm means/vars);
+- ``apply(params, state, input, training=..., rng=...)`` returns
+  ``(output, new_state)`` and is pure → jit/grad/vmap/shard_map-compatible;
+- **there is no hand-written backward anywhere** — ``jax.grad`` of the loss
+  w.r.t. ``params`` replaces ``updateGradInput`` + ``accGradParameters``.
+
+For API parity with BigDL scripts (``model.forward(x)``; gradient checks),
+Module also offers a thin *eager* convenience layer that stores
+``(params, state)`` on the object and calls the pure ``apply`` under the
+hood; training loops never use it.
+
+``Activity`` (reference ``Activity.scala:33``: Tensor | Table) maps to
+"array | tuple/list/dict of arrays" — i.e. any pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+def _as_rng(seed_or_rng) -> jax.Array:
+    if isinstance(seed_or_rng, int):
+        return jax.random.PRNGKey(seed_or_rng)
+    return seed_or_rng
+
+
+class Module:
+    """Base class of all layers.  See module docstring for the contract."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name if name is not None else type(self).__name__
+        # eager-convenience slots (not part of the pure contract)
+        self._params: Any = None
+        self._state: Any = None
+        self._grads: Any = None
+        self.training: bool = True
+
+    # ---------------------------------------------------------------- pure
+    def init(self, rng: jax.Array):
+        """Return ``(params, state)`` pytrees. Stateless layers return ({}, {})."""
+        return {}, {}
+
+    def apply(self, params, state, input, *, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        """Pure forward: return ``(output, new_state)``."""
+        raise NotImplementedError(type(self).__name__)
+
+    # ------------------------------------------------------- eager parity
+    def initialize(self, rng=0) -> "Module":
+        """Materialize params on the object (eager/demo use only)."""
+        self._params, self._state = self.init(_as_rng(rng))
+        self._grads = jax.tree_util.tree_map(jnp.zeros_like, self._params)
+        return self
+
+    def _ensure_init(self):
+        if self._params is None:
+            self.initialize()
+
+    def forward(self, input, rng: Optional[jax.Array] = None):
+        """Eager forward (reference: ``AbstractModule.forward``, `:254`)."""
+        self._ensure_init()
+        out, self._state = self.apply(self._params, self._state, input,
+                                      training=self.training, rng=rng)
+        self.output = out
+        return out
+
+    def __call__(self, input, rng: Optional[jax.Array] = None):
+        return self.forward(input, rng=rng)
+
+    def backward(self, input, grad_output, rng: Optional[jax.Array] = None):
+        """Eager backward via ``jax.vjp`` — replaces the reference's
+        hand-written ``updateGradInput``+``accGradParameters``
+        (``AbstractModule.scala:280-287``).  Accumulates into ``self._grads``
+        (reference semantics: accGradParameters *accumulates*) and returns
+        grad_input."""
+        self._ensure_init()
+
+        def fwd(params, x):
+            y, _ = self.apply(params, self._state, x,
+                              training=self.training, rng=rng)
+            return y
+
+        _, vjp = jax.vjp(fwd, self._params, input)
+        d_params, d_input = vjp(grad_output)
+        self._grads = jax.tree_util.tree_map(jnp.add, self._grads, d_params)
+        self.grad_input = d_input
+        return d_input
+
+    def zero_grad_parameters(self):
+        if self._grads is not None:
+            self._grads = jax.tree_util.tree_map(jnp.zeros_like, self._grads)
+
+    # ------------------------------------------------------------- modes
+    def evaluate(self) -> "Module":
+        """Switch eager mode to inference (reference ``:429-445``)."""
+        self.training = False
+        return self
+
+    def training_mode(self) -> "Module":
+        self.training = True
+        return self
+
+    # -------------------------------------------------------- parameters
+    def parameters(self):
+        """Eager ``(params, grads)`` pair (reference ``parameters()``, `:337`)."""
+        self._ensure_init()
+        return self._params, self._grads
+
+    def get_parameters(self):
+        """Flat-vector view of params + an unravel fn.
+
+        The reference compacts all weights into one flat Tensor
+        (``getParameters()``) because its AllReduce/checkpoint layers assume
+        a flat view; here the pytree is primary and the flat view is derived.
+        """
+        self._ensure_init()
+        flat, unravel = ravel_pytree(self._params)
+        return flat, unravel
+
+    def set_parameters(self, params):
+        self._params = params
+        return self
+
+    # -------------------------------------------------------------- misc
+    def set_name(self, name: str) -> "Module":
+        self.name = name
+        return self
+
+    def get_name(self) -> str:
+        return self.name
+
+    def __repr__(self):
+        return f"{type(self).__name__}[{self.name}]"
+
+
+class Container(Module):
+    """Composite module holding children (reference ``Container.scala:40``).
+
+    Child params/state are stored as dicts keyed by ``"{index}"`` so the
+    pytree structure is stable under jit and independent of layer names
+    (names may repeat)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.modules: list[Module] = []
+
+    def add(self, module: Module) -> "Container":
+        self.modules.append(module)
+        return self
+
+    def __len__(self):
+        return len(self.modules)
+
+    def __getitem__(self, i) -> Module:
+        return self.modules[i]
+
+    def init(self, rng):
+        params, state = {}, {}
+        for i, m in enumerate(self.modules):
+            rng, sub = jax.random.split(rng)
+            p, s = m.init(sub)
+            params[str(i)] = p
+            state[str(i)] = s
+        return params, state
+
+    def _split_rng(self, rng, n):
+        if rng is None:
+            return [None] * n
+        return list(jax.random.split(rng, n))
+
+
+class Sequential(Container):
+    """Feed children in order (reference ``Sequential.scala:31``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input
+        new_state = {}
+        rngs = self._split_rng(rng, len(self.modules))
+        for i, m in enumerate(self.modules):
+            out, s = m.apply(params[str(i)], state[str(i)], out,
+                             training=training, rng=rngs[i])
+            new_state[str(i)] = s
+        return out, new_state
+
+
+class ConcatTable(Container):
+    """Apply every child to the same input, return a tuple
+    (reference ``ConcatTable``: Tensor → Table)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], {}
+        rngs = self._split_rng(rng, len(self.modules))
+        for i, m in enumerate(self.modules):
+            o, s = m.apply(params[str(i)], state[str(i)], input,
+                           training=training, rng=rngs[i])
+            outs.append(o)
+            new_state[str(i)] = s
+        return tuple(outs), new_state
+
+
+class ParallelTable(Container):
+    """Apply the i-th child to the i-th input element (reference
+    ``ParallelTable``: Table → Table)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], {}
+        rngs = self._split_rng(rng, len(self.modules))
+        for i, m in enumerate(self.modules):
+            o, s = m.apply(params[str(i)], state[str(i)], input[i],
+                           training=training, rng=rngs[i])
+            outs.append(o)
+            new_state[str(i)] = s
+        return tuple(outs), new_state
+
+
+class Concat(Container):
+    """Apply every child to the input and concatenate outputs along ``dim``
+    (reference ``Concat.scala``; dim counts the batch axis, default 1 =
+    feature/channel axis, matching BigDL's 1-based dimension minus one —
+    here dims are 0-based with batch at 0, so channel concat is dim=1)."""
+
+    def __init__(self, dim: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs, new_state = [], {}
+        rngs = self._split_rng(rng, len(self.modules))
+        for i, m in enumerate(self.modules):
+            o, s = m.apply(params[str(i)], state[str(i)], input,
+                           training=training, rng=rngs[i])
+            outs.append(o)
+            new_state[str(i)] = s
+        return jnp.concatenate(outs, axis=self.dim), new_state
+
+
+class Identity(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class Echo(Module):
+    """Debug layer: prints shape at trace time (reference ``Echo.scala``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        shapes = jax.tree_util.tree_map(lambda x: x.shape, input)
+        print(f"[Echo {self.name}] {shapes}")
+        return input, state
+
+
+class Lambda(Module):
+    """Wrap a pure function as a stateless layer (no reference analog;
+    replaces dozens of trivial tensor-manip layers in user code)."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        super().__init__(name)
+        self.fn = fn
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self.fn(input), state

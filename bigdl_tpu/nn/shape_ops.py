@@ -1,0 +1,416 @@
+"""Tensor-manipulation and table (pytree) layers.
+
+Reference: the large family of shape/table layers at ``DL/nn/`` —
+``Reshape``, ``View``, ``Squeeze``, ``Transpose``, ``Narrow``, ``Select``,
+``JoinTable``, ``SplitTable``, ``CAddTable``, ``CMulTable``, ``MulConstant``,
+``Power``, ``Mean``, ``Sum`` … Each is a thin jnp expression; they exist so
+BigDL-style ``Sequential`` graphs translate one-to-one.
+
+Dims here are 0-based with batch at axis 0.  The reference is Torch-style
+1-based; its common idiom "dim 1 = feature" maps to ``dim=1`` here too
+because batch occupies axis 0 in both conventions when batched.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class Reshape(Module):
+    """Reshape keeping the batch axis (reference ``Reshape.scala`` with
+    batchMode=Some(true) semantics)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = True, name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self.batch_mode:
+            return input.reshape((input.shape[0],) + self.size), state
+        return input.reshape(self.size), state
+
+
+class View(Reshape):
+    """Alias of Reshape (reference ``View.scala``; -1 inference supported
+    by jnp.reshape)."""
+    pass
+
+
+class Flatten(Module):
+    """Flatten all non-batch dims (BigDL scripts use Reshape for this; kept
+    as sugar)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input.reshape(input.shape[0], -1), state
+
+
+class Squeeze(Module):
+    def __init__(self, dim: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.squeeze(input, axis=self.dim), state
+
+
+class Unsqueeze(Module):
+    def __init__(self, pos: int, name=None):
+        super().__init__(name)
+        self.pos = pos
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.expand_dims(input, self.pos), state
+
+
+class Transpose(Module):
+    """Swap listed dim pairs (reference ``Transpose.scala``)."""
+
+    def __init__(self, permutations: Sequence[tuple[int, int]], name=None):
+        super().__init__(name)
+        self.permutations = list(permutations)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input
+        for a, b in self.permutations:
+            out = jnp.swapaxes(out, a, b)
+        return out, state
+
+
+class Contiguous(Module):
+    """No-op under XLA (reference ``Contiguous.scala`` forces a copy for
+    MKL; XLA owns layout)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class Narrow(Module):
+    """Slice ``length`` elements from ``offset`` along ``dim``
+    (reference ``Narrow.scala``; offset 0-based here)."""
+
+    def __init__(self, dim: int, offset: int, length: int, name=None):
+        super().__init__(name)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n = self.length if self.length >= 0 \
+            else input.shape[self.dim] - self.offset + self.length + 1
+        return jax.lax.slice_in_dim(input, self.offset, self.offset + n,
+                                    axis=self.dim), state
+
+
+class Select(Module):
+    """Select index along dim, dropping it (reference ``Select.scala``)."""
+
+    def __init__(self, dim: int, index: int, name=None):
+        super().__init__(name)
+        self.dim, self.index = dim, index
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.take(input, self.index, axis=self.dim), state
+
+
+class Index(Module):
+    """Gather rows along dim by an index tensor: input=(tensor, indices)
+    (reference ``Index.scala``)."""
+
+    def __init__(self, dim: int, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x, idx = input
+        return jnp.take(x, idx.astype(jnp.int32), axis=self.dim), state
+
+
+class Padding(Module):
+    """Pad ``pad`` zeros (or ``value``) on one side of ``dim``
+    (reference ``Padding.scala``: negative pad → leading side)."""
+
+    def __init__(self, dim: int, pad: int, value: float = 0.0, name=None):
+        super().__init__(name)
+        self.dim, self.pad, self.value = dim, pad, value
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        cfg = [(0, 0)] * input.ndim
+        cfg[self.dim] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, cfg, constant_values=self.value), state
+
+
+class SpatialZeroPadding(Module):
+    """(reference ``SpatialZeroPadding.scala``) pad H/W of NCHW."""
+
+    def __init__(self, pad_left: int, pad_right: int, pad_top: int,
+                 pad_bottom: int, name=None):
+        super().__init__(name)
+        self.cfg = (pad_left, pad_right, pad_top, pad_bottom)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        l, r, t, b = self.cfg
+        return jnp.pad(input, ((0, 0), (0, 0), (t, b), (l, r))), state
+
+
+class JoinTable(Module):
+    """Concatenate a table of tensors along dim (reference
+    ``JoinTable.scala``)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.concatenate(list(input), axis=self.dimension), state
+
+
+class SplitTable(Module):
+    """Split a tensor into a table along dim (reference
+    ``SplitTable.scala``)."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n = input.shape[self.dimension]
+        parts = jnp.split(input, n, axis=self.dimension)
+        return tuple(jnp.squeeze(p, axis=self.dimension) for p in parts), state
+
+
+class CAddTable(Module):
+    """Elementwise sum of a table (reference ``CAddTable.scala`` — the
+    ResNet shortcut join)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = out + x
+        return out, state
+
+
+class CMulTable(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = out * x
+        return out, state
+
+
+class CSubTable(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input[0] - input[1], state
+
+
+class CDivTable(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input[0] / input[1], state
+
+
+class CMaxTable(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = jnp.maximum(out, x)
+        return out, state
+
+
+class CMinTable(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = input[0]
+        for x in input[1:]:
+            out = jnp.minimum(out, x)
+        return out, state
+
+
+class FlattenTable(Module):
+    """Flatten nested table (reference ``FlattenTable.scala``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        flat = []
+
+        def rec(t):
+            if isinstance(t, (tuple, list)):
+                for e in t:
+                    rec(e)
+            else:
+                flat.append(t)
+
+        rec(input)
+        return tuple(flat), state
+
+
+class SelectTable(Module):
+    def __init__(self, index: int, name=None):
+        super().__init__(name)
+        self.index = index
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input[self.index], state
+
+
+class MulConstant(Module):
+    def __init__(self, scalar: float, name=None):
+        super().__init__(name)
+        self.scalar = scalar
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * self.scalar, state
+
+
+class AddConstant(Module):
+    def __init__(self, constant_scalar: float, name=None):
+        super().__init__(name)
+        self.constant_scalar = constant_scalar
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input + self.constant_scalar, state
+
+
+class Power(Module):
+    """(shift + scale*x)^power (reference ``Power.scala``)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name=None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * input, self.power), state
+
+
+class Sqrt(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.sqrt(input), state
+
+
+class Square(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * input, state
+
+
+class Abs(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.abs(input), state
+
+
+class Exp(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.exp(input), state
+
+
+class Log(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.log(input), state
+
+
+class Clamp(Module):
+    def __init__(self, min_v: float, max_v: float, name=None):
+        super().__init__(name)
+        self.min_v, self.max_v = min_v, max_v
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.clip(input, self.min_v, self.max_v), state
+
+
+class Mean(Module):
+    """(reference ``Mean.scala``) mean over ``dimension``; squeeze like the
+    reference (squeeze=true default)."""
+
+    def __init__(self, dimension: int = 0, squeeze: bool = True, name=None):
+        super().__init__(name)
+        self.dimension, self.squeeze = dimension, squeeze
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.mean(input, axis=self.dimension,
+                        keepdims=not self.squeeze), state
+
+
+class Sum(Module):
+    def __init__(self, dimension: int = 0, squeeze: bool = True, name=None):
+        super().__init__(name)
+        self.dimension, self.squeeze = dimension, squeeze
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.sum(input, axis=self.dimension,
+                       keepdims=not self.squeeze), state
+
+
+class Max(Module):
+    def __init__(self, dim: int = 0, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.max(input, axis=self.dim), state
+
+
+class Min(Module):
+    def __init__(self, dim: int = 0, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.min(input, axis=self.dim), state
+
+
+class Replicate(Module):
+    """Insert a new dim of size n_features (reference ``Replicate.scala``)."""
+
+    def __init__(self, n_features: int, dim: int = 0, name=None):
+        super().__init__(name)
+        self.n_features, self.dim = n_features, dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = jnp.expand_dims(input, self.dim)
+        reps = [1] * out.ndim
+        reps[self.dim] = self.n_features
+        return jnp.tile(out, reps), state
+
+
+class Pack(Module):
+    """Stack a table along a new dim (reference ``Pack.scala``)."""
+
+    def __init__(self, dim: int, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.stack(list(input), axis=self.dim), state
+
+
+class Scale(Module):
+    """CMul + CAdd (reference ``Scale.scala``)."""
+
+    def __init__(self, size: Sequence[int], name=None):
+        super().__init__(name)
+        from bigdl_tpu.nn.layers import CMul, CAdd
+        self.cmul = CMul(size)
+        self.cadd = CAdd(size)
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        p1, _ = self.cmul.init(k1)
+        p2, _ = self.cadd.init(k2)
+        return {"mul": p1, "add": p2}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y, _ = self.cmul.apply(params["mul"], {}, input)
+        y, _ = self.cadd.apply(params["add"], {}, y)
+        return y, state
+
+
+class Masking(Module):
+    """Zero timesteps equal to mask_value (reference ``Masking.scala``)."""
+
+    def __init__(self, mask_value: float = 0.0, name=None):
+        super().__init__(name)
+        self.mask_value = mask_value
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        keep = jnp.any(input != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, input, 0.0), state

@@ -1,0 +1,30 @@
+"""Test harness config.
+
+Mirrors the reference's distributed-without-a-cluster test trick
+(``TEST/optim/DistriOptimizerSpec.scala:139`` uses ``local[1]`` Spark): we
+run every test on a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count=8`` so sharding/collective paths
+are exercised without TPU hardware.  MUST be set before jax import.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+# NOTE: the env var JAX_PLATFORMS is stomped by the axon TPU plugin in this
+# image; the config API wins, so force CPU here (must precede device use).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,113 @@
+"""Criterion unit tests (reference: per-criterion Specs in ``TEST/nn/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+
+
+def test_class_nll():
+    logp = jnp.log(jnp.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
+    target = jnp.array([0, 1])
+    loss = nn.ClassNLLCriterion().forward(logp, target)
+    np.testing.assert_allclose(loss, -(np.log(0.7) + np.log(0.8)) / 2, rtol=1e-4)
+
+
+def test_cross_entropy_equals_logsoftmax_plus_nll():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 5))
+    target = jnp.array([0, 2, 4, 1])
+    ce = nn.CrossEntropyCriterion().forward(logits, target)
+    manual = nn.ClassNLLCriterion().forward(jax.nn.log_softmax(logits), target)
+    np.testing.assert_allclose(ce, manual, rtol=1e-5)
+
+
+def test_nll_ignore_index():
+    logp = jnp.log(jnp.array([[0.5, 0.5], [0.9, 0.1]]))
+    loss = nn.ClassNLLCriterion(ignore_index=-100).forward(
+        logp, jnp.array([0, -100]))
+    np.testing.assert_allclose(loss, -np.log(0.5), rtol=1e-5)
+
+
+def test_mse():
+    loss = nn.MSECriterion().forward(jnp.array([1.0, 2.0]), jnp.array([0.0, 0.0]))
+    np.testing.assert_allclose(loss, 2.5)
+    loss_sum = nn.MSECriterion(size_average=False).forward(
+        jnp.array([1.0, 2.0]), jnp.array([0.0, 0.0]))
+    np.testing.assert_allclose(loss_sum, 5.0)
+
+
+def test_bce_matches_manual():
+    x = jnp.array([0.8, 0.3])
+    t = jnp.array([1.0, 0.0])
+    loss = nn.BCECriterion().forward(x, t)
+    np.testing.assert_allclose(loss, -(np.log(0.8) + np.log(0.7)) / 2, rtol=1e-5)
+
+
+def test_bce_with_logits_matches_bce():
+    logits = jnp.array([1.5, -0.5, 0.2])
+    t = jnp.array([1.0, 0.0, 1.0])
+    a = nn.BCEWithLogitsCriterion().forward(logits, t)
+    b = nn.BCECriterion().forward(jax.nn.sigmoid(logits), t)
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_smooth_l1():
+    loss = nn.SmoothL1Criterion().forward(jnp.array([0.5, 3.0]), jnp.array([0.0, 0.0]))
+    np.testing.assert_allclose(loss, (0.5 * 0.25 + 2.5) / 2)
+
+
+def test_margin():
+    loss = nn.MarginCriterion().forward(jnp.array([0.5, 2.0]), jnp.array([1.0, 1.0]))
+    np.testing.assert_allclose(loss, 0.25)
+
+
+def test_kld_vae():
+    mean = jnp.zeros((2, 3))
+    log_var = jnp.zeros((2, 3))
+    np.testing.assert_allclose(nn.KLDCriterion().forward((mean, log_var), None), 0.0)
+
+
+def test_criterion_backward_is_grad():
+    logits = jax.random.normal(jax.random.PRNGKey(1), (3, 4))
+    target = jnp.array([0, 1, 2])
+    c = nn.CrossEntropyCriterion()
+    gi = c.backward(logits, target)
+    assert gi.shape == logits.shape
+    # gradient of mean-CE sums to ~0 per row minus one-hot/N
+    np.testing.assert_allclose(jnp.sum(gi), 0.0, atol=1e-5)
+
+
+def test_parallel_criterion():
+    pc = nn.ParallelCriterion().add(nn.MSECriterion(), 0.5).add(nn.MSECriterion(), 1.0)
+    x = (jnp.array([1.0]), jnp.array([2.0]))
+    t = (jnp.array([0.0]), jnp.array([0.0]))
+    np.testing.assert_allclose(pc.forward(x, t), 0.5 * 1.0 + 1.0 * 4.0)
+
+
+def test_time_distributed_criterion():
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 5, 4))
+    t = jnp.zeros((2, 5), dtype=jnp.int32)
+    loss = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion()).forward(x, t)
+    assert loss.shape == ()
+
+
+def test_time_distributed_sum_inner_no_average():
+    # inner sum-reducing criterion, size_average=False (default): plain sum
+    x = jnp.ones((2, 3, 4))
+    t = jnp.zeros((2, 3, 4))
+    loss = nn.TimeDistributedCriterion(
+        nn.MSECriterion(size_average=False)).forward(x, t)
+    np.testing.assert_allclose(loss, 24.0)
+    # size_average=True divides by timesteps
+    loss_avg = nn.TimeDistributedCriterion(
+        nn.MSECriterion(size_average=False), size_average=True).forward(x, t)
+    np.testing.assert_allclose(loss_avg, 8.0)
+
+
+def test_multilabel_margin_class_zero_with_padding():
+    # single true class 0, padded with -1: perfect score -> zero loss
+    x = jnp.array([[1.0, 0.0, 0.0]])
+    t = jnp.array([[0, -1, -1]])
+    loss = nn.MultiLabelMarginCriterion().forward(x, t)
+    np.testing.assert_allclose(loss, 0.0)
