@@ -1,0 +1,82 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Analog of the reference's throughput harness
+``DL/models/utils/DistriOptimizerPerf.scala:56-140`` (synthetic-input
+records/sec).  Runs the flagship model's jit'd training step on the real
+TPU chip and reports images/sec/chip.
+
+The reference repo publishes no absolute images/sec numbers
+(BASELINE.md) — ``vs_baseline`` is therefore the ratio against a fixed
+reference point recorded here (first-round TPU measurement) so rounds are
+comparable.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+# first recorded TPU v5e-1 measurement for this benchmark config; later
+# rounds report improvement vs this anchor
+BASELINE_IMAGES_PER_SEC = 4879874.5  # TPU v5 lite, batch 1024, 2026-07-29
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.models.lenet import lenet5
+
+    model = lenet5()
+    criterion = nn.ClassNLLCriterion()
+    method = optim.SGD(learning_rate=0.01, momentum=0.9)
+
+    batch = 1024
+    rng = jax.random.PRNGKey(0)
+    params, mstate = model.init(rng)
+    ostate = method.init_state(params)
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        0, 1, (batch, 1, 28, 28)).astype(np.float32))
+    y = jnp.asarray(np.random.default_rng(1).integers(
+        0, 10, (batch,)).astype(np.int32))
+
+    def loss_fn(p, ms, x, y):
+        out, new_ms = model.apply(p, ms, x, training=True)
+        return criterion.apply(out, y), new_ms
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    @jax.jit
+    def step(p, ms, os_, x, y, lr, it):
+        (loss, ms), g = grad_fn(p, ms, x, y)
+        p, os_ = method.update(g, p, os_, lr, it)
+        return p, ms, os_, loss
+
+    # warmup/compile
+    params, mstate, ostate, loss = step(params, mstate, ostate, x, y, 0.01, 0)
+    jax.block_until_ready(loss)
+
+    iters = 50
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, mstate, ostate, loss = step(params, mstate, ostate, x, y,
+                                            0.01, i)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    ips = batch * iters / dt
+
+    vs = 1.0 if BASELINE_IMAGES_PER_SEC is None \
+        else ips / BASELINE_IMAGES_PER_SEC
+    print(json.dumps({
+        "metric": "lenet5_train_images_per_sec_per_chip",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

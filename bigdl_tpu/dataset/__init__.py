@@ -1,1 +1,15 @@
-"""(populated in subsequent milestones)"""
+"""bigdl_tpu.dataset — data pipeline (reference ``DL/dataset/`` +
+``DL/transform/vision/``)."""
+
+from bigdl_tpu.dataset.sample import (
+    Sample, MiniBatch, PaddingParam, batch_samples,
+)
+from bigdl_tpu.dataset.transformer import (
+    Transformer, ChainedTransformer, FnTransformer, SampleToMiniBatch,
+)
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, LocalDataSet, DistributedDataSet, TransformedDataSet,
+    DataSet,
+)
+from bigdl_tpu.dataset import image
+from bigdl_tpu.dataset import mnist

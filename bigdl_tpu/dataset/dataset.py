@@ -1,0 +1,150 @@
+"""DataSet — the training-data container.
+
+Reference: ``DL/dataset/DataSet.scala`` — ``AbstractDataSet`` (`:57-68`:
+``data(train)``, ``shuffle``, ``size``), ``LocalDataSet:113``,
+``DistributedDataSet:167``, ``CachedDistriDataSet:243`` (per-partition
+cached array + shuffled index array; training iterator is infinite,
+sampling ``localData(indexes(i % len))``).
+
+TPU redesign: Spark partitions → per-host shards.  ``DistributedDataSet``
+shards the index space by ``jax.process_index()`` (each host holds/reads
+only its shard — the analog of ``coalesce(nodeNumber)`` + locality zip),
+shuffles indices host-locally each epoch exactly like the reference's
+index-permutation trick (``DataSet.scala:295-302``), and the global batch
+is assembled across hosts by the mesh (each host contributes its slice of
+the batch via ``jax.make_array_from_process_local_data``-style sharding in
+the distributed optimizer).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class AbstractDataSet:
+    def data(self, train: bool) -> Iterator:
+        """Infinite shuffled iterator when train, one-pass when not
+        (reference ``AbstractDataSet.data``)."""
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer: Transformer) -> "TransformedDataSet":
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """In-memory array dataset (reference ``LocalDataSet:113``): training
+    iterator is infinite over a permuted index array; ``shuffle`` re-permutes
+    indices only (data never moves)."""
+
+    def __init__(self, data: Sequence, seed: int = 1):
+        self._data = data
+        self._rng = np.random.default_rng(seed)
+        self._indexes = np.arange(len(data))
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def shuffle(self) -> None:
+        self._rng.shuffle(self._indexes)
+
+    def data(self, train: bool) -> Iterator:
+        if train:
+            def infinite():
+                i = 0
+                n = len(self._data)
+                while True:
+                    yield self._data[self._indexes[i % n]]
+                    i += 1
+            return infinite()
+        return iter(self._data)
+
+
+class DistributedDataSet(AbstractDataSet):
+    """Per-host sharded dataset.  Host p of P sees indices p::P — the analog
+    of the reference's ``coalesce(nodeNumber, true)`` partition placement
+    (``DataSet.scala:340-344``).  All hosts permute with the same seed so
+    epoch boundaries stay aligned (SPMD requires lock-step batch counts)."""
+
+    def __init__(self, data: Sequence, seed: int = 1,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        self._data = data
+        self._p = jax.process_index() if process_index is None else process_index
+        self._np = jax.process_count() if process_count is None else process_count
+        self._seed = seed
+        self._epoch = 0
+        self._global_indexes = np.arange(len(data))
+
+    def size(self) -> int:
+        """GLOBAL size (reference DistributedDataSet.size is the RDD count)."""
+        return len(self._data)
+
+    def local_size(self) -> int:
+        return len(range(self._p, len(self._data), self._np))
+
+    def shuffle(self) -> None:
+        self._epoch += 1
+        rng = np.random.default_rng(self._seed + self._epoch)
+        self._global_indexes = rng.permutation(len(self._data))
+
+    def data(self, train: bool) -> Iterator:
+        local = self._global_indexes[self._p::self._np]
+        if train:
+            def infinite():
+                i = 0
+                while True:
+                    # re-read shard each wrap so shuffle() takes effect
+                    cur = self._global_indexes[self._p::self._np]
+                    yield self._data[cur[i % len(cur)]]
+                    i += 1
+            return infinite()
+        return (self._data[i] for i in local)
+
+
+class TransformedDataSet(AbstractDataSet):
+    """DataSet with a transformer pipeline attached (reference: the result
+    of ``dataset -> transformer``)."""
+
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self) -> None:
+        self.base.shuffle()
+
+    def data(self, train: bool) -> Iterator:
+        return self.transformer(self.base.data(train))
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self.base, self.transformer >> transformer)
+
+
+class DataSet:
+    """Factory namespace (reference ``DataSet.array/rdd/imageFrame``,
+    ``DataSet.scala:322+``)."""
+
+    @staticmethod
+    def array(data: Sequence, distributed: bool = False,
+              seed: int = 1) -> AbstractDataSet:
+        if distributed:
+            return DistributedDataSet(data, seed=seed)
+        return LocalDataSet(data, seed=seed)

@@ -1,0 +1,184 @@
+"""Image preprocessing transformers (host-side, numpy).
+
+Reference: ``DL/dataset/image/`` (23 files: ``BytesToGreyImg``,
+``GreyImgNormalizer``, ``BGRImgCropper``, ``ColorJitter``, ``Lighting``,
+``HFlip``, …) and the vision-2.0 augmentation ops under
+``DL/transform/vision/image/augmentation/``.  The reference does this with
+JNI OpenCV; here it is pure numpy on the host CPU — augmentation happens
+before ``device_put``, never on the TPU.
+
+Greyscale images flow as float32 (H, W); BGR/RGB images as float32 (H, W, C).
+Each transformer maps Sample→Sample so pipelines read like the reference:
+``dataset >> GreyImgNormalizer(mean, std) >> GreyImgToSample() >> SampleToMiniBatch(b)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class _SampleMap(Transformer):
+    def _map(self, s: Sample) -> Sample:
+        raise NotImplementedError
+
+    def __call__(self, it):
+        return (self._map(s) for s in it)
+
+
+class BytesToGreyImg(_SampleMap):
+    """uint8 (H,W) → float32 (reference ``BytesToGreyImg``)."""
+
+    def _map(self, s):
+        return Sample(s.feature.astype(np.float32), s.label)
+
+
+class GreyImgNormalizer(_SampleMap):
+    """(x - mean) / std (reference ``GreyImgNormalizer``)."""
+
+    def __init__(self, mean: float, std: float):
+        self.mean, self.std = mean, std
+
+    def _map(self, s):
+        f = (s.feature.astype(np.float32) - self.mean) / self.std
+        return Sample(f, s.label)
+
+
+class GreyImgToSample(_SampleMap):
+    """Add the channel dim: (H,W) → (1,H,W) (reference ``GreyImgToBatch``
+    does this while batching; batching itself is SampleToMiniBatch here)."""
+
+    def _map(self, s):
+        return Sample(s.feature[None, :, :].astype(np.float32), s.label)
+
+
+class BGRImgNormalizer(_SampleMap):
+    """Per-channel (x-mean)/std on (H,W,C) (reference ``BGRImgNormalizer``)."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def _map(self, s):
+        return Sample((s.feature - self.mean) / self.std, s.label)
+
+
+class HFlip(_SampleMap):
+    """Random horizontal flip (reference ``HFlip``)."""
+
+    def __init__(self, threshold: float = 0.5, seed: int = 0):
+        self.threshold = threshold
+        self._rng = np.random.default_rng(seed)
+
+    def _map(self, s):
+        if self._rng.random() < self.threshold:
+            return Sample(np.ascontiguousarray(s.feature[:, ::-1]), s.label)
+        return s
+
+
+class RandomCropper(_SampleMap):
+    """Random crop to (h, w), optionally after padding (reference
+    ``BGRImgCropper``/``RandomCropper``; the CIFAR recipe pads 4 then crops
+    32)."""
+
+    def __init__(self, crop_h: int, crop_w: int, pad: int = 0, seed: int = 0):
+        self.crop_h, self.crop_w, self.pad = crop_h, crop_w, pad
+        self._rng = np.random.default_rng(seed)
+
+    def _map(self, s):
+        f = s.feature
+        chw = f.ndim == 3 and f.shape[0] <= 4  # (C,H,W) vs (H,W[,C])
+        if self.pad:
+            if chw:
+                f = np.pad(f, ((0, 0), (self.pad, self.pad),
+                               (self.pad, self.pad)))
+            elif f.ndim == 3:
+                f = np.pad(f, ((self.pad, self.pad), (self.pad, self.pad),
+                               (0, 0)))
+            else:
+                f = np.pad(f, self.pad)
+        H, W = (f.shape[1], f.shape[2]) if chw else (f.shape[0], f.shape[1])
+        y = self._rng.integers(0, H - self.crop_h + 1)
+        x = self._rng.integers(0, W - self.crop_w + 1)
+        if chw:
+            out = f[:, y:y + self.crop_h, x:x + self.crop_w]
+        else:
+            out = f[y:y + self.crop_h, x:x + self.crop_w]
+        return Sample(np.ascontiguousarray(out), s.label)
+
+
+class CenterCropper(_SampleMap):
+    def __init__(self, crop_h: int, crop_w: int):
+        self.crop_h, self.crop_w = crop_h, crop_w
+
+    def _map(self, s):
+        f = s.feature
+        chw = f.ndim == 3 and f.shape[0] <= 4
+        H, W = (f.shape[1], f.shape[2]) if chw else (f.shape[0], f.shape[1])
+        y, x = (H - self.crop_h) // 2, (W - self.crop_w) // 2
+        out = f[:, y:y + self.crop_h, x:x + self.crop_w] if chw \
+            else f[y:y + self.crop_h, x:x + self.crop_w]
+        return Sample(np.ascontiguousarray(out), s.label)
+
+
+class ColorJitter(_SampleMap):
+    """Random brightness/contrast/saturation on (H,W,C) float images
+    (reference ``ColorJitter``)."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4, seed: int = 0):
+        self.b, self.c, self.s = brightness, contrast, saturation
+        self._rng = np.random.default_rng(seed)
+
+    def _map(self, s):
+        f = s.feature.astype(np.float32)
+        order = self._rng.permutation(3)
+        for op in order:
+            if op == 0 and self.b > 0:
+                f = f * (1 + self._rng.uniform(-self.b, self.b))
+            elif op == 1 and self.c > 0:
+                mean = f.mean()
+                f = (f - mean) * (1 + self._rng.uniform(-self.c, self.c)) + mean
+            elif op == 2 and self.s > 0 and f.ndim == 3:
+                grey = f.mean(axis=-1, keepdims=True)
+                f = grey + (f - grey) * (1 + self._rng.uniform(-self.s, self.s))
+        return Sample(f, s.label)
+
+
+class Lighting(_SampleMap):
+    """AlexNet-style PCA lighting noise (reference ``Lighting``; eigen
+    vectors/values of ImageNet RGB)."""
+
+    _eigval = np.array([0.2175, 0.0188, 0.0045], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alphastd: float = 0.1, seed: int = 0):
+        self.alphastd = alphastd
+        self._rng = np.random.default_rng(seed)
+
+    def _map(self, s):
+        alpha = self._rng.normal(0, self.alphastd, 3).astype(np.float32)
+        delta = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return Sample(s.feature + delta, s.label)
+
+
+class ChannelOrder(_SampleMap):
+    """HWC→CHW (or back) (the reference stores BGR HWC and transposes when
+    batching)."""
+
+    def __init__(self, to: str = "CHW"):
+        self.to = to
+
+    def _map(self, s):
+        f = s.feature
+        if self.to == "CHW" and f.ndim == 3:
+            return Sample(np.ascontiguousarray(f.transpose(2, 0, 1)), s.label)
+        if self.to == "HWC" and f.ndim == 3:
+            return Sample(np.ascontiguousarray(f.transpose(1, 2, 0)), s.label)
+        return s

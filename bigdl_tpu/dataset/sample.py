@@ -1,0 +1,117 @@
+"""Sample and MiniBatch.
+
+Reference: ``DL/dataset/Sample.scala:32`` (features+label ndarrays, flat
+storage) and ``DL/dataset/MiniBatch.scala:34`` (``ArrayTensorMiniBatch``
+with ``slice`` for per-thread sub-batching).
+
+Host-side data is numpy (cheap mutation, no device churn); a MiniBatch's
+arrays move to device HBM when the jit'd step consumes them.  ``slice``
+is kept for parity/sub-batching; per-core sub-batching itself is obsolete
+under SPMD (the mesh shards the batch instead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+class Sample:
+    """One training example: feature array(s) + label array(s)."""
+
+    __slots__ = ("feature", "label")
+
+    def __init__(self, feature, label=None):
+        self.feature = feature
+        self.label = label
+
+    @staticmethod
+    def from_ndarray(feature, label=None) -> "Sample":
+        f = np.asarray(feature)
+        l = None if label is None else np.asarray(label)
+        return Sample(f, l)
+
+    def feature_size(self):
+        return self.feature.shape
+
+    def label_size(self):
+        return None if self.label is None else self.label.shape
+
+    def __repr__(self):
+        ls = None if self.label is None else self.label.shape
+        return f"Sample(feature={self.feature.shape}, label={ls})"
+
+
+class MiniBatch:
+    """Batched input/target pair (pytrees of arrays with leading batch dim)."""
+
+    __slots__ = ("input", "target")
+
+    def __init__(self, input, target=None):
+        self.input = input
+        self.target = target
+
+    def size(self) -> int:
+        leaf = self.input
+        while isinstance(leaf, (tuple, list, dict)):
+            leaf = next(iter(leaf.values())) if isinstance(leaf, dict) \
+                else leaf[0]
+        return leaf.shape[0]
+
+    def slice(self, offset: int, length: int) -> "MiniBatch":
+        """Sub-batch [offset, offset+length) (reference
+        ``MiniBatch.scala:155``)."""
+
+        def cut(x):
+            if isinstance(x, dict):
+                return {k: cut(v) for k, v in x.items()}
+            if isinstance(x, (tuple, list)):
+                return type(x)(cut(e) for e in x)
+            return x[offset:offset + length]
+
+        return MiniBatch(cut(self.input),
+                         None if self.target is None else cut(self.target))
+
+    def __repr__(self):
+        return f"MiniBatch(size={self.size()})"
+
+
+@dataclass
+class PaddingParam:
+    """Variable-length padding config (reference ``Transformer.scala``
+    PaddingParam): pad every sequence in the batch to the longest (or to
+    ``fixed_length``) with ``padding_value``."""
+
+    padding_value: float = 0.0
+    fixed_length: Optional[int] = None
+
+
+def _stack_padded(arrays: Sequence[np.ndarray], param: Optional[PaddingParam]):
+    """Stack arrays; if ragged in dim 0 (sequence), pad per PaddingParam."""
+    shapes = {a.shape for a in arrays}
+    if len(shapes) == 1 and param is None:
+        return np.stack(arrays)
+    if param is None:
+        raise ValueError(
+            f"ragged samples {sorted(shapes)} need a PaddingParam")
+    max_len = param.fixed_length or max(a.shape[0] for a in arrays)
+    out_shape = (len(arrays), max_len) + arrays[0].shape[1:]
+    out = np.full(out_shape, param.padding_value, dtype=arrays[0].dtype)
+    for i, a in enumerate(arrays):
+        out[i, :a.shape[0]] = a
+    return out
+
+
+def batch_samples(samples: Sequence[Sample],
+                  feature_padding: Optional[PaddingParam] = None,
+                  label_padding: Optional[PaddingParam] = None) -> MiniBatch:
+    """Collate samples into a MiniBatch (reference ``SampleToMiniBatch``
+    internals)."""
+    feats = _stack_padded([s.feature for s in samples], feature_padding)
+    if samples[0].label is None:
+        return MiniBatch(feats, None)
+    labels = _stack_padded([np.asarray(s.label) for s in samples],
+                           label_padding)
+    return MiniBatch(feats, labels)

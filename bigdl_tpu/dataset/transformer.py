@@ -1,0 +1,86 @@
+"""Transformers — composable preprocessing pipelines.
+
+Reference: ``DL/dataset/Transformer.scala:44`` — ``Transformer[A,B]`` maps
+``Iterator[A] → Iterator[B]`` and composes with ``->``
+(``ChainedTransformer:88``); the public idiom is
+``DataSet.array(...) -> BytesToGreyImg() -> GreyImgNormalizer(...) -> GreyImgToBatch(...)``
+(``models/lenet/Train.scala:72-74``).
+
+Python has no ``->`` operator; composition is ``>>`` (or ``.chain``):
+``dataset >> BytesToGreyImg() >> GreyImgNormalizer(m, s) >> SampleToMiniBatch(b)``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import (
+    MiniBatch, PaddingParam, Sample, batch_samples,
+)
+
+
+class Transformer:
+    """Iterator→Iterator stage; compose with ``>>``."""
+
+    def __call__(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        return ChainedTransformer(self, other)
+
+    def chain(self, other: "Transformer") -> "ChainedTransformer":
+        return self >> other
+
+
+class ChainedTransformer(Transformer):
+    """(reference ``Transformer.scala:88``)"""
+
+    def __init__(self, first: Transformer, second: Transformer):
+        self.first, self.second = first, second
+
+    def __call__(self, it):
+        return self.second(self.first(it))
+
+
+class FnTransformer(Transformer):
+    """Map a per-element function (covers most one-off reference
+    transformers)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, it):
+        return (self.fn(x) for x in it)
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into MiniBatches (reference
+    ``Transformer.scala:309`` SampleToMiniBatch, with PaddingParam support
+    for variable-length sequences).
+
+    ``drop_remainder`` defaults True for training (static shapes — a ragged
+    final batch would trigger an XLA recompile; the reference instead
+    right-sizes batches to the core count)."""
+
+    def __init__(self, batch_size: int,
+                 feature_padding: Optional[PaddingParam] = None,
+                 label_padding: Optional[PaddingParam] = None,
+                 drop_remainder: bool = True):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.drop_remainder = drop_remainder
+
+    def __call__(self, it):
+        buf = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield batch_samples(buf, self.feature_padding,
+                                    self.label_padding)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield batch_samples(buf, self.feature_padding, self.label_padding)

@@ -1,1 +1,3 @@
-"""(populated in subsequent milestones)"""
+"""Model zoo (reference ``DL/models/``)."""
+
+from bigdl_tpu.models.lenet import lenet5

@@ -1,1 +1,25 @@
-"""(populated in subsequent milestones)"""
+"""bigdl_tpu.optim — optimization methods, schedules, triggers, metrics,
+training loops (reference ``DL/optim/`` + ``DL/parameters/``)."""
+
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod, SGD, Adam, ParallelAdam, Adagrad, Adadelta, Adamax,
+    RMSprop, Ftrl,
+)
+from bigdl_tpu.optim.schedules import (
+    LearningRateSchedule, Default, Step, MultiStep, EpochStep, EpochDecay,
+    Poly, Exponential, NaturalExp, Warmup, SequentialSchedule, Plateau,
+    EpochSchedule, EpochDecayWithWarmUp,
+)
+from bigdl_tpu.optim.trigger import (
+    Trigger, every_epoch, several_iteration, max_epoch, max_iteration,
+    max_score, min_loss,
+)
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
+    MAE, HitRatio, NDCG, TreeNNAccuracy,
+)
+from bigdl_tpu.optim.optimizer import (
+    Optimizer, LocalOptimizer, clip_by_value, clip_by_global_norm,
+    global_norm,
+)
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer

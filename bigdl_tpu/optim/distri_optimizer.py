@@ -1,0 +1,225 @@
+"""DistriOptimizer — synchronous data-parallel training over a TPU mesh.
+
+Reference: ``DL/optim/DistriOptimizer.scala`` (1,106 LoC) +
+``DL/parameters/AllReduceParameter.scala``: each Spark iteration is 2 jobs —
+(A) per-executor forward/backward with BlockManager weight fetch and FP16
+gradient put, (B) per-node aggregation of its 1/N gradient slice, optimizer
+update of its 1/N weight slice, weight re-publish.  That is literally a
+reduce-scatter + all-gather with a sharded optimizer update (ZeRO-1).
+
+TPU redesign: ONE jit'd SPMD train step over a ``jax.sharding.Mesh``.
+
+- The global batch is sharded over the ``data`` mesh axis (the analog of
+  one data partition per executor).
+- Params are replicated; XLA inserts the gradient AllReduce over ICI when
+  it sees sharded-batch grads meet replicated params — replacing
+  ``putGradients``/``aggregateGradientPartition`` (+ its FP16 wire format:
+  ICI needs no software compression).
+- With ``parameter_sharding=True`` (default), optimizer state is sharded
+  over the mesh via sharding annotations, so XLA emits reduce-scatter +
+  sharded update + all-gather — the exact ZeRO-1 pattern of
+  ``AllReduceParameter`` (each node owns 1/N of the flat vector and runs
+  the optimizer on its slice only, ``AllReduceParameter.scala:73-76``).
+  (See also "Automatic Cross-Replica Sharding of Weight Update in
+  Data-Parallel Training", arXiv:2004.13336 — the same design.)
+- Straggler gradient-dropping (``DistriOptimizer.scala:398-425``) is
+  intentionally absent: SPMD collectives are lock-step; XLA's synchronous
+  model replaces it (documented divergence, SURVEY.md §7 stage 4).
+- Failure retry-from-checkpoint (``:981-1061``) is in the driver loop.
+
+Multi-host: each process feeds its local shard of the global batch via
+``jax.make_array_from_process_local_data``; ``jax.distributed.initialize``
+is the analog of Spark executor registration.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+tmap = jax.tree_util.tree_map
+
+
+def batch_axis_spec(leaf, mesh: Mesh, axis: str = "data") -> P:
+    """Shard dim 0 over the mesh axis when divisible, else replicate —
+    used for ZeRO-1-style optimizer-state sharding."""
+    n = mesh.shape[axis]
+    if leaf.ndim > 0 and leaf.shape[0] % n == 0 and leaf.shape[0] >= n:
+        return P(axis)
+    return P()
+
+
+class DistriOptimizer(Optimizer):
+    """Data-parallel SPMD trainer.  See module docstring."""
+
+    def __init__(self, model, dataset, criterion, batch_size=None,
+                 mesh: Optional[Mesh] = None,
+                 parameter_sharding: bool = True):
+        super().__init__(model, dataset, criterion, batch_size)
+        self.mesh = mesh or Engine.get_mesh()
+        self.parameter_sharding = parameter_sharding
+        self.failure_retry_times = Engine._state.failure_retry_times
+
+    # -------------------------------------------------------- shardings
+    def _shardings(self, params, ostate):
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P("data"))
+        param_sh = tmap(lambda _: repl, params)
+        if self.parameter_sharding:
+            ostate_sh = tmap(
+                lambda l: NamedSharding(mesh, batch_axis_spec(l, mesh)),
+                ostate)
+        else:
+            ostate_sh = tmap(lambda _: repl, ostate)
+        return repl, data, param_sh, ostate_sh
+
+    def _make_global(self, arr: np.ndarray, sharding: NamedSharding):
+        """Per-host local shard → global device array (multi-host safe)."""
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_process_local_data(sharding, arr)
+
+    # ------------------------------------------------------------- train
+    def optimize(self):
+        attempts = 0
+        while True:
+            try:
+                return self._optimize_impl()
+            except Exception:
+                # reference retry-from-checkpoint loop
+                # (DistriOptimizer.scala:981-1061)
+                attempts += 1
+                if attempts > self.failure_retry_times \
+                        or not self.checkpoint_path:
+                    raise
+                ckpt = latest_checkpoint(self.checkpoint_path)
+                if ckpt is None:
+                    raise
+                logger.exception(
+                    "training failed; retry %d/%d from %s",
+                    attempts, self.failure_retry_times, ckpt)
+                blob = load_checkpoint(ckpt)
+                self.model._params = blob["params"]
+                self.model._state = blob["model_state"]
+                # restore optimizer state too (reference reloads the
+                # OptimMethod state table) — else Adam moments/SGD velocity
+                # reset to zero and the resumed step spikes
+                self._resume_opt_state = blob["opt_state"]
+                if blob["driver_state"]:
+                    self.state.update(blob["driver_state"])
+
+    def _optimize_impl(self):
+        mesh = self.mesh
+        n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        rng = jax.random.PRNGKey(self.seed)
+        rng, init_rng = jax.random.split(rng)
+        if self.model._params is not None:
+            params, mstate = self.model._params, self.model._state
+        else:
+            params, mstate = self.model.init(init_rng)
+        if self._resume_opt_state is not None:
+            ostate = self._resume_opt_state
+            self._resume_opt_state = None
+        else:
+            ostate = self.optim_method.init_state(params)
+        repl, data_sh, param_sh, ostate_sh = self._shardings(params, ostate)
+
+        # place initial values
+        params = tmap(lambda x, s: jax.device_put(x, s), params, param_sh)
+        ostate = tmap(lambda x, s: jax.device_put(x, s), ostate, ostate_sh)
+        mstate = tmap(lambda x: jax.device_put(x, repl), mstate)
+
+        grad_fn = self._loss_and_grad_fn()
+        grad_clip = self.grad_clip
+        optim = self.optim_method
+
+        mstate_sh = tmap(lambda _: repl, mstate)
+
+        @jax.jit
+        def train_step(params, mstate, ostate, x, y, lr, step, rng):
+            """Global-semantics SPMD step: x/y are sharded over `data`;
+            XLA inserts the grad AllReduce (params replicated) or
+            reduce-scatter/all-gather (ostate sharded) over ICI."""
+            (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
+            if grad_clip is not None:
+                grads = grad_clip(grads)
+            params, ostate = optim.update(grads, params, ostate, lr, step)
+            # pin output layouts so the pattern stays reduce-scatter+gather
+            params = jax.lax.with_sharding_constraint(params, param_sh)
+            ostate = jax.lax.with_sharding_constraint(ostate, ostate_sh)
+            return params, new_mstate, ostate, loss
+
+        data_iter = self.dataset.data(train=True)
+        epoch_size = self.dataset.size()
+        state = self.state
+        self._fast_forward(data_iter, state)
+        logger.info(
+            "DistriOptimizer: %d samples/epoch, mesh=%s, zero1=%s",
+            epoch_size, dict(zip(mesh.axis_names, mesh.devices.shape)),
+            self.parameter_sharding)
+
+        while not self.end_when(state):
+            t0 = time.perf_counter()
+            with self.metrics.time("data"):
+                batch = next(data_iter)
+                # inputs may be pytrees (multi-input models)
+                x = tmap(lambda a: self._make_global(np.asarray(a), data_sh),
+                         batch.input)
+                y = tmap(lambda a: self._make_global(np.asarray(a), data_sh),
+                         batch.target)
+            global_batch = batch.size()
+            lr = self.optim_method.current_lr(state["neval"], state["epoch"])
+            rng, step_rng = jax.random.split(rng)
+            with self.metrics.time("computing"):
+                params, mstate, ostate, loss = train_step(
+                    params, mstate, ostate, x, y, lr, state["neval"],
+                    step_rng)
+                loss = float(loss)
+            dt = time.perf_counter() - t0
+
+            state["neval"] += 1
+            state["records_processed_this_epoch"] += global_batch
+            state["loss"] = loss
+            state["throughput"] = global_batch / dt
+            logger.info(
+                "epoch %d iter %d loss %.4f lr %.5g throughput %.1f rec/s "
+                "(%.1f rec/s/dev)",
+                state["epoch"], state["neval"], loss, lr,
+                state["throughput"], state["throughput"] / n_dev)
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar("LearningRate", lr,
+                                              state["neval"])
+                self.train_summary.add_scalar("Throughput",
+                                              state["throughput"],
+                                              state["neval"])
+
+            state["epoch_finished"] = \
+                state["records_processed_this_epoch"] >= epoch_size
+            if state["epoch_finished"]:
+                state["epoch"] += 1
+                state["records_processed_this_epoch"] = 0
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
+
+            self._run_validation(params, mstate)
+            self._maybe_checkpoint(params, mstate, ostate)
+            state["epoch_finished"] = False
+
+        self.model._params = params
+        self.model._state = mstate
+        self._final_opt_state = ostate
+        return self.model

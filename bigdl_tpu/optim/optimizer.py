@@ -1,0 +1,343 @@
+"""Optimizer — the training front door.
+
+Reference: ``DL/optim/Optimizer.scala:47`` builder API (``setValidation``,
+``setCheckpoint:198``, ``overWriteCheckpoint:233``, ``setOptimMethod:366``,
+``setEndWhen:389``, gradient clipping ``:423+``) whose factory dispatches
+``LocalOptimizer`` (single JVM) vs ``DistriOptimizer`` (Spark).
+
+Here: :class:`Optimizer` holds the builder surface + the shared driver loop
+machinery; :class:`LocalOptimizer` jit-compiles the train step for the
+local device (1 TPU chip); ``DistriOptimizer`` (bigdl_tpu.optim.
+distri_optimizer) shard_maps it over the mesh.  The factory
+``Optimizer.create`` mirrors the reference's dispatch.
+
+Gradient clipping maps the reference's ``ConstantClippingProcessor`` /
+``L2NormClippingProcessor`` (``parameters/ParameterOperations.scala:71,89``)
+to pure pytree ops inside the jit'd step — the cross-partition sqsum
+aggregation becomes a global norm over the (already full) gradient pytree,
+and under data parallelism the psum'd gradient is identical on every
+device, so clipping semantics match the reference exactly.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.nn.criterion import Criterion
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.optim_method import OptimMethod, SGD
+from bigdl_tpu.optim.trigger import Trigger, max_epoch
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.utils.checkpoint import save_checkpoint
+from bigdl_tpu.utils.metrics import Metrics
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+tmap = jax.tree_util.tree_map
+
+
+def device_tree(x):
+    """Move a (possibly nested tuple/list/dict) batch onto device —
+    MiniBatch inputs may be pytrees (multi-input models), so a blind
+    ``jnp.asarray`` would mis-stack them into one array."""
+    return tmap(jnp.asarray, x)
+
+
+def clip_by_value(grads, min_v: float, max_v: float):
+    """(reference ConstantClippingProcessor)"""
+    return tmap(lambda g: jnp.clip(g, min_v, max_v), grads)
+
+
+def global_norm(grads) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves))
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    """(reference L2NormClippingProcessor — global norm across all slices)"""
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return tmap(lambda g: g * scale, grads)
+
+
+class Optimizer:
+    """Builder + driver-loop base."""
+
+    def __init__(self, model: Module, dataset: AbstractDataSet,
+                 criterion: Criterion, batch_size: Optional[int] = None):
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.batch_size = batch_size
+
+        self.optim_method: OptimMethod = SGD()
+        self.end_when: Trigger = max_epoch(1)
+        self.validation_trigger: Optional[Trigger] = None
+        self.validation_dataset: Optional[AbstractDataSet] = None
+        self.validation_methods: Sequence[ValidationMethod] = ()
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.checkpoint_path: Optional[str] = None
+        self.overwrite_checkpoint = True
+        self.grad_clip: Optional[Callable] = None
+        self.train_summary = None
+        self.validation_summary = None
+        self.metrics = Metrics()
+        self.seed = 1
+
+        # driver state (reference: the state Table inside OptimMethod —
+        # epoch/neval survive checkpoint/resume)
+        self.state: dict = {"epoch": 0, "neval": 0,
+                            "records_processed_this_epoch": 0}
+        self._eval_fwd = None  # cached jit'd eval forward
+        self._resume_opt_state = None  # optimizer state restored on retry
+
+    # ------------------------------------------------------------- builder
+    def set_optim_method(self, method: OptimMethod) -> "Optimizer":
+        self.optim_method = method
+        return self
+
+    def set_end_when(self, trigger: Trigger) -> "Optimizer":
+        self.end_when = trigger
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset: AbstractDataSet,
+                       methods: Sequence[ValidationMethod],
+                       batch_size: Optional[int] = None) -> "Optimizer":
+        self.validation_trigger = trigger
+        self.validation_dataset = dataset
+        self.validation_methods = list(methods)
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    def over_write_checkpoint(self) -> "Optimizer":
+        self.overwrite_checkpoint = True
+        return self
+
+    def set_gradient_clipping_by_value(self, min_v: float,
+                                       max_v: float) -> "Optimizer":
+        self.grad_clip = lambda g: clip_by_value(g, min_v, max_v)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, max_norm: float) -> "Optimizer":
+        self.grad_clip = lambda g: clip_by_global_norm(g, max_norm)
+        return self
+
+    def disable_gradient_clipping(self) -> "Optimizer":
+        self.grad_clip = None
+        return self
+
+    def set_train_summary(self, summary) -> "Optimizer":
+        self.train_summary = summary
+        return self
+
+    def set_val_summary(self, summary) -> "Optimizer":
+        self.validation_summary = summary
+        return self
+
+    def set_seed(self, seed: int) -> "Optimizer":
+        self.seed = seed
+        return self
+
+    def set_state(self, state: dict) -> "Optimizer":
+        """Resume driver state (epoch/neval) from a checkpoint."""
+        self.state.update(state)
+        return self
+
+    # ------------------------------------------------------------ factory
+    @staticmethod
+    def create(model: Module, dataset: AbstractDataSet, criterion: Criterion,
+               distributed: Optional[bool] = None, **kw):
+        """(reference ``Optimizer.apply`` factories, ``Optimizer.scala:597+``)"""
+        from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+        if distributed is None:
+            distributed = jax.device_count() > 1
+        cls = DistriOptimizer if distributed else LocalOptimizer
+        return cls(model, dataset, criterion, **kw)
+
+    def optimize(self) -> Module:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- shared
+    def _loss_and_grad_fn(self):
+        model, criterion = self.model, self.criterion
+
+        def loss_fn(params, mstate, x, y, rng):
+            out, new_mstate = model.apply(params, mstate, x,
+                                          training=True, rng=rng)
+            return criterion.apply(out, y), new_mstate
+
+        return jax.value_and_grad(loss_fn, has_aux=True)
+
+    def _fast_forward(self, data_iter, state):
+        """Mid-epoch resume: skip the samples already processed this epoch
+        so the epoch boundary (and shuffle cadence) stays correct
+        (reference: recordsProcessedThisEpoch in the OptimMethod state
+        table, ``DistriOptimizer.scala:124-134``)."""
+        skip = state.get("records_processed_this_epoch", 0)
+        skipped = 0
+        while skipped < skip:
+            skipped += next(data_iter).size()
+        if skipped:
+            logger.info("resume: skipped %d already-processed records",
+                        skipped)
+
+    def _maybe_checkpoint(self, params, mstate, ostate):
+        if self.checkpoint_trigger and self.checkpoint_path \
+                and self.checkpoint_trigger(self.state):
+            f = save_checkpoint(self.checkpoint_path, params, mstate, ostate,
+                                driver_state=self.state,
+                                neval=self.state["neval"],
+                                overwrite=self.overwrite_checkpoint)
+            logger.info("checkpoint saved to %s", f)
+
+    def _run_validation(self, params, mstate) -> Optional[dict]:
+        if not (self.validation_trigger and self.validation_methods
+                and self.validation_dataset is not None
+                and self.validation_trigger(self.state)):
+            return None
+        results = self.evaluate_with(params, mstate)
+        for name, res in results.items():
+            logger.info("validation %s = %s", name, res)
+            if self.validation_summary is not None:
+                self.validation_summary.add_scalar(name, res.result,
+                                                   self.state["neval"])
+        # expose primary score to triggers; feed metric-driven schedules
+        # (Plateau) exactly once per validation — NOT once per iteration
+        first = next(iter(results.values()))
+        self.state["score"] = first.result
+        sched = self.optim_method.learning_rate_schedule
+        if sched is not None and hasattr(sched, "record"):
+            sched.record(first.result)
+        return results
+
+    def evaluate_with(self, params, mstate) -> dict:
+        """Forward the validation set through the model in eval mode."""
+        if self._eval_fwd is None:
+            model = self.model
+
+            @jax.jit
+            def fwd(params, mstate, x):
+                out, _ = model.apply(params, mstate, x, training=False)
+                return out
+
+            self._eval_fwd = fwd
+
+        acc: dict[str, ValidationResult] = {}
+        for batch in self.validation_dataset.data(train=False):
+            if not isinstance(batch, MiniBatch):
+                raise TypeError("validation dataset must yield MiniBatch "
+                                "(attach SampleToMiniBatch)")
+            out = self._eval_fwd(params, mstate, device_tree(batch.input))
+            for m in self.validation_methods:
+                r = m(out, device_tree(batch.target))
+                acc[m.name] = acc[m.name] + r if m.name in acc else r
+        if not acc:
+            raise ValueError(
+                "validation dataset yielded no batches — its size is smaller "
+                "than the batch size and SampleToMiniBatch dropped the "
+                "remainder; use SampleToMiniBatch(n, drop_remainder=False) "
+                "for validation or shrink the batch")
+        return acc
+
+
+class LocalOptimizer(Optimizer):
+    """Single-host training loop (reference ``LocalOptimizer.scala:45``).
+
+    The reference clones the model per core and sums gradients across
+    thread replicas; under XLA one jit'd step uses the whole chip, so the
+    loop is: next batch → jit'd (loss, grad, update) → triggers.
+    """
+
+    def optimize(self) -> Module:
+        rng = jax.random.PRNGKey(self.seed)
+        rng, init_rng = jax.random.split(rng)
+        if self.model._params is not None:
+            params, mstate = self.model._params, self.model._state
+        else:
+            params, mstate = self.model.init(init_rng)
+        if self._resume_opt_state is not None:
+            ostate = self._resume_opt_state
+            self._resume_opt_state = None
+        else:
+            ostate = self.optim_method.init_state(params)
+
+        grad_fn = self._loss_and_grad_fn()
+        grad_clip = self.grad_clip
+        optim = self.optim_method
+
+        @jax.jit
+        def train_step(params, mstate, ostate, x, y, lr, step, rng):
+            (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
+            if grad_clip is not None:
+                grads = grad_clip(grads)
+            params, ostate = optim.update(grads, params, ostate, lr, step)
+            return params, new_mstate, ostate, loss
+
+        data_iter = self.dataset.data(train=True)
+        epoch_size = self.dataset.size()
+        state = self.state
+        self._fast_forward(data_iter, state)
+        logger.info("LocalOptimizer: %d samples/epoch, device=%s",
+                    epoch_size, jax.devices()[0])
+
+        while not self.end_when(state):
+            t0 = time.perf_counter()
+            with self.metrics.time("data"):
+                batch = next(data_iter)
+            n_records = batch.size()
+            lr = self.optim_method.current_lr(state["neval"], state["epoch"])
+            rng, step_rng = jax.random.split(rng)
+            with self.metrics.time("computing"):
+                params, mstate, ostate, loss = train_step(
+                    params, mstate, ostate,
+                    device_tree(batch.input), device_tree(batch.target),
+                    lr, state["neval"], step_rng)
+                loss = float(loss)
+            dt = time.perf_counter() - t0
+
+            state["neval"] += 1
+            state["records_processed_this_epoch"] += n_records
+            state["loss"] = loss
+            state["throughput"] = n_records / dt
+            # reference per-iteration log line (DistriOptimizer.scala:388-394)
+            logger.info(
+                "epoch %d iter %d loss %.4f lr %.5g throughput %.1f rec/s",
+                state["epoch"], state["neval"], loss, lr, state["throughput"])
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss, state["neval"])
+                self.train_summary.add_scalar("LearningRate", lr,
+                                              state["neval"])
+                self.train_summary.add_scalar("Throughput",
+                                              state["throughput"],
+                                              state["neval"])
+
+            state["epoch_finished"] = \
+                state["records_processed_this_epoch"] >= epoch_size
+            if state["epoch_finished"]:
+                state["epoch"] += 1
+                state["records_processed_this_epoch"] = 0
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
+
+            self._run_validation(params, mstate)
+            self._maybe_checkpoint(params, mstate, ostate)
+            state["epoch_finished"] = False
+
+        # write trained weights back into the user's model object
+        # (reference: final getModel copy, DistriOptimizer.scala:1063)
+        self.model._params = params
+        self.model._state = mstate
+        self._final_opt_state = ostate
+        return self.model

@@ -1,0 +1,237 @@
+"""Learning-rate schedules.
+
+Reference: the schedule family nested in ``DL/optim/SGD.scala:200-690`` —
+``Default``, ``Step:329``, ``MultiStep:360``, ``EpochStep``, ``EpochDecay:397``,
+``Poly:290``, ``Exponential``, ``NaturalExp``, ``Regime``/``EpochSchedule:233``,
+``Plateau``, ``Warmup:+600``, ``SequentialSchedule:+624`` — required by the
+ResNet/Inception training recipes.
+
+Contract: ``schedule(base_lr, iteration, epoch, metric=None) -> lr`` runs on
+the **host** each step; the resulting scalar is fed into the jit'd update as
+a traced argument, so changing lr never recompiles.  Stateful schedules
+(Plateau) keep their state on the python object — host-side, like the
+reference's driver-side SGD state table.
+
+Iterations and epochs are 0-based.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+
+class LearningRateSchedule:
+    def __call__(self, base_lr: float, iteration: int, epoch: int,
+                 metric: Optional[float] = None) -> float:
+        raise NotImplementedError
+
+    #: iterations consumed (used by SequentialSchedule)
+    def __len__(self):  # pragma: no cover - overridden where meaningful
+        return 0
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + decay * iteration) (reference SGD default when
+    learningRateDecay is set)."""
+
+    def __init__(self, learning_rate_decay: float = 0.0):
+        self.decay = learning_rate_decay
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        return base_lr / (1.0 + self.decay * iteration)
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(iter/step_size)) (reference ``SGD.scala:329``)."""
+
+    def __init__(self, step_size: int, gamma: float = 0.1):
+        self.step_size, self.gamma = step_size, gamma
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        return base_lr * self.gamma ** (iteration // self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """Drop by gamma at each listed iteration (reference ``SGD.scala:360``)."""
+
+    def __init__(self, step_sizes: Sequence[int], gamma: float = 0.1):
+        self.step_sizes, self.gamma = list(step_sizes), gamma
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        n = sum(1 for s in self.step_sizes if iteration >= s)
+        return base_lr * self.gamma ** n
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor(epoch/step_size)) (reference EpochStep)."""
+
+    def __init__(self, step_size: int, gamma: float = 0.1):
+        self.step_size, self.gamma = step_size, gamma
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        return base_lr * self.gamma ** (epoch // self.step_size)
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay_fn(epoch) with a user fn (reference ``SGD.scala:397``)."""
+
+    def __init__(self, decay_fn):
+        self.decay_fn = decay_fn
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        return base_lr * 0.1 ** self.decay_fn(epoch)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - iter/max_iter)^power (reference ``SGD.scala:290``; the
+    Inception-v1 recipe's schedule)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        if iteration >= self.max_iteration:
+            return 0.0
+        return base_lr * (1.0 - iteration / self.max_iteration) ** self.power
+
+    def __len__(self):
+        return self.max_iteration
+
+
+class Exponential(LearningRateSchedule):
+    """lr * gamma^(iter/decay_step), optionally staircased
+    (reference Exponential)."""
+
+    def __init__(self, decay_step: int, decay_rate: float,
+                 stair_case: bool = False):
+        self.decay_step, self.decay_rate = decay_step, decay_rate
+        self.stair_case = stair_case
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        p = iteration / self.decay_step
+        if self.stair_case:
+            p = math.floor(p)
+        return base_lr * self.decay_rate ** p
+
+
+class NaturalExp(LearningRateSchedule):
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step, self.gamma = decay_step, gamma
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        return base_lr * math.exp(-self.gamma * (iteration // self.decay_step))
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp base_lr → base_lr + delta*warmup_iters over warmup_iters
+    (reference ``SGD.scala`` Warmup; the ResNet batch-8192 recipe warms up
+    5 epochs to maxLr)."""
+
+    def __init__(self, delta: float, warmup_iteration: int):
+        self.delta = delta
+        self.warmup_iteration = warmup_iteration
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        return base_lr + self.delta * min(iteration, self.warmup_iteration)
+
+    def __len__(self):
+        return self.warmup_iteration
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each consuming its ``len()`` iterations
+    (reference ``SGD.scala`` SequentialSchedule)."""
+
+    def __init__(self, *schedules: LearningRateSchedule):
+        self.schedules = list(schedules)
+
+    def add(self, schedule: LearningRateSchedule,
+            max_iteration: Optional[int] = None):
+        if max_iteration is not None:
+            schedule._seq_len = max_iteration  # type: ignore[attr-defined]
+        self.schedules.append(schedule)
+        return self
+
+    @staticmethod
+    def _length(s):
+        return getattr(s, "_seq_len", None) or len(s)
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        it = iteration
+        for s in self.schedules[:-1]:
+            n = self._length(s)
+            if it < n:
+                return s(base_lr, it, epoch, metric)
+            it -= n
+        return self.schedules[-1](base_lr, it, epoch, metric)
+
+
+class Plateau(LearningRateSchedule):
+    """Drop lr by ``factor`` when the monitored metric stops improving
+    (reference SGD Plateau; metric-driven, stateful)."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.mode, self.epsilon = mode, epsilon
+        self.cooldown, self.min_lr = cooldown, min_lr
+        self._best: Optional[float] = None
+        self._wait = 0
+        self._cooldown_left = 0
+        self._scale = 1.0
+
+    def record(self, metric: float):
+        """Feed the monitored metric (called by the optimizer after each
+        validation)."""
+        better = (self._best is None
+                  or (self.mode == "min" and metric < self._best - self.epsilon)
+                  or (self.mode == "max" and metric > self._best + self.epsilon))
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+        if better:
+            self._best = metric
+            self._wait = 0
+        elif self._cooldown_left == 0:
+            self._wait += 1
+            if self._wait >= self.patience:
+                self._scale *= self.factor
+                self._wait = 0
+                self._cooldown_left = self.cooldown
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        if metric is not None:
+            self.record(metric)
+        return max(base_lr * self._scale, self.min_lr)
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Piecewise regimes by epoch range (reference ``SGD.scala:233``
+    Regime/EpochSchedule — AlexNet-style recipes)."""
+
+    def __init__(self, regimes: Sequence[tuple[int, int, float]]):
+        """regimes: (start_epoch, end_epoch_inclusive, lr) with 0-based epochs."""
+        self.regimes = list(regimes)
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        for start, end, lr in self.regimes:
+            if start <= epoch <= end:
+                return lr
+        return base_lr
+
+
+class EpochDecayWithWarmUp(LearningRateSchedule):
+    """Linear warmup then epoch-wise decay fn (reference
+    EpochDecayWithWarmUp)."""
+
+    def __init__(self, warmup_iteration: int, warmup_delta: float, decay_fn):
+        self.warmup_iteration = warmup_iteration
+        self.warmup_delta = warmup_delta
+        self.decay_fn = decay_fn
+
+    def __call__(self, base_lr, iteration, epoch, metric=None):
+        if iteration < self.warmup_iteration:
+            return base_lr + self.warmup_delta * iteration
+        max_lr = base_lr + self.warmup_delta * self.warmup_iteration
+        return max_lr * 0.1 ** self.decay_fn(epoch)

@@ -1,0 +1,113 @@
+"""Triggers — cadence/stop conditions for training loops.
+
+Reference: ``DL/optim/Trigger.scala:30-119`` (``everyEpoch``,
+``severalIteration``, ``maxEpoch``, ``maxIteration``, ``maxScore``,
+``minLoss``), composable with and/or.  A trigger is a predicate over the
+driver's training state dict.
+
+State keys (mirroring the reference's state Table): ``epoch`` (0-based,
+current), ``neval`` (iteration counter, 1-based after first step),
+``loss``, ``score``, and ``epoch_finished`` (set by the loop at epoch
+boundaries so everyEpoch fires once per rollover).
+"""
+
+from __future__ import annotations
+
+
+class Trigger:
+    def __call__(self, state: dict) -> bool:
+        raise NotImplementedError
+
+    def and_(self, other: "Trigger") -> "Trigger":
+        return _And(self, other)
+
+    def or_(self, other: "Trigger") -> "Trigger":
+        return _Or(self, other)
+
+
+class _And(Trigger):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def __call__(self, state):
+        return self.a(state) and self.b(state)
+
+
+class _Or(Trigger):
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def __call__(self, state):
+        return self.a(state) or self.b(state)
+
+
+class _EveryEpoch(Trigger):
+    def __call__(self, state):
+        return bool(state.get("epoch_finished", False))
+
+
+class _SeveralIteration(Trigger):
+    def __init__(self, interval: int):
+        self.interval = interval
+
+    def __call__(self, state):
+        n = state.get("neval", 0)
+        return n > 0 and n % self.interval == 0
+
+
+class _MaxEpoch(Trigger):
+    def __init__(self, max_epoch: int):
+        self.max_epoch = max_epoch
+
+    def __call__(self, state):
+        return state.get("epoch", 0) >= self.max_epoch
+
+
+class _MaxIteration(Trigger):
+    def __init__(self, max_iteration: int):
+        self.max_iteration = max_iteration
+
+    def __call__(self, state):
+        return state.get("neval", 0) >= self.max_iteration
+
+
+class _MaxScore(Trigger):
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def __call__(self, state):
+        s = state.get("score")
+        return s is not None and s >= self.max_score
+
+
+class _MinLoss(Trigger):
+    def __init__(self, min_loss: float):
+        self.min_loss = min_loss
+
+    def __call__(self, state):
+        l = state.get("loss")
+        return l is not None and l <= self.min_loss
+
+
+def every_epoch() -> Trigger:
+    return _EveryEpoch()
+
+
+def several_iteration(interval: int) -> Trigger:
+    return _SeveralIteration(interval)
+
+
+def max_epoch(n: int) -> Trigger:
+    return _MaxEpoch(n)
+
+
+def max_iteration(n: int) -> Trigger:
+    return _MaxIteration(n)
+
+
+def max_score(s: float) -> Trigger:
+    return _MaxScore(s)
+
+
+def min_loss(l: float) -> Trigger:
+    return _MinLoss(l)

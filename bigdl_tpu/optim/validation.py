@@ -1,0 +1,137 @@
+"""Validation methods (metrics).
+
+Reference: ``DL/optim/ValidationMethod.scala`` — ``Top1Accuracy:170``,
+``Top5Accuracy:224``, ``HitRatio:279``, ``NDCG:346``, ``Loss:475``,
+``MAE:500``.  Metrics are **associative** ``ValidationResult``s so they
+reduce across partitions/devices — the same property lets us ``psum`` the
+(numerator, denominator) pair across a mesh here.
+
+Each method exposes ``batch_stats(output, target) -> (value, count)`` as a
+pure jit-able function; ``ValidationResult``s accumulate host-side.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class ValidationResult:
+    """Associative (value, count) accumulator (reference
+    ``ContiguousResult``/``LossResult``)."""
+
+    def __init__(self, value: float, count: float, fmt: str = "{:.6f}"):
+        self.value = float(value)
+        self.count = float(count)
+        self.fmt = fmt
+
+    @property
+    def result(self) -> float:
+        return self.value / max(self.count, 1e-12)
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        return ValidationResult(self.value + other.value,
+                                self.count + other.count, self.fmt)
+
+    def __repr__(self):
+        return f"{self.fmt.format(self.result)} ({int(self.count)} samples)"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def batch_stats(self, output, target):
+        """Pure: return (summed value, count) for one batch."""
+        raise NotImplementedError
+
+    def __call__(self, output, target) -> ValidationResult:
+        v, c = self.batch_stats(output, target)
+        return ValidationResult(float(v), float(c))
+
+    def __repr__(self):
+        return self.name
+
+
+class Top1Accuracy(ValidationMethod):
+    """(reference ``ValidationMethod.scala:170``)"""
+    name = "Top1Accuracy"
+
+    def batch_stats(self, output, target):
+        pred = jnp.argmax(output, axis=-1)
+        correct = jnp.sum(pred == target.astype(pred.dtype))
+        return correct, target.shape[0]
+
+
+class Top5Accuracy(ValidationMethod):
+    """(reference ``ValidationMethod.scala:224``)"""
+    name = "Top5Accuracy"
+
+    def batch_stats(self, output, target):
+        _, top5 = jax.lax.top_k(output, 5)
+        hit = jnp.any(top5 == target.astype(top5.dtype)[..., None], axis=-1)
+        return jnp.sum(hit), target.shape[0]
+
+
+class Loss(ValidationMethod):
+    """Criterion value as a metric (reference ``ValidationMethod.scala:475``)."""
+    name = "Loss"
+
+    def __init__(self, criterion=None):
+        from bigdl_tpu.nn.criterion import CrossEntropyCriterion
+        self.criterion = criterion or CrossEntropyCriterion()
+
+    def batch_stats(self, output, target):
+        n = output.shape[0] if hasattr(output, "shape") else 1
+        return self.criterion.apply(output, target) * n, n
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error (reference ``ValidationMethod.scala:500``)."""
+    name = "MAE"
+
+    def batch_stats(self, output, target):
+        err = jnp.mean(jnp.abs(output - target),
+                       axis=tuple(range(1, output.ndim)))
+        return jnp.sum(err), output.shape[0]
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for recommendation (reference ``ValidationMethod.scala:279``):
+    output = scores over [positive, negatives...] per row; hit if the
+    positive (column 0) ranks in top-k."""
+    name = "HitRatio"
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def batch_stats(self, output, target=None):
+        pos = output[:, 0:1]
+        rank = jnp.sum(output[:, 1:] > pos, axis=-1) + 1
+        return jnp.sum(rank <= self.k), output.shape[0]
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k, positive item at column 0 (reference
+    ``ValidationMethod.scala:346``)."""
+    name = "NDCG"
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def batch_stats(self, output, target=None):
+        pos = output[:, 0:1]
+        rank = jnp.sum(output[:, 1:] > pos, axis=-1) + 1
+        gain = jnp.where(rank <= self.k, 1.0 / jnp.log2(rank + 1.0), 0.0)
+        return jnp.sum(gain), output.shape[0]
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """(reference ``ValidationMethod.scala:118``) accuracy on the root
+    prediction of tree outputs — output (N, T, C), root at t=0."""
+    name = "TreeNNAccuracy"
+
+    def batch_stats(self, output, target):
+        pred = jnp.argmax(output[:, 0], axis=-1)
+        return jnp.sum(pred == target.astype(pred.dtype)), target.shape[0]

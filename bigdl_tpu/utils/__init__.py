@@ -1,1 +1,9 @@
-"""(populated in subsequent milestones)"""
+"""bigdl_tpu.utils — checkpointing, metrics, TensorBoard summaries."""
+
+from bigdl_tpu.utils.checkpoint import (
+    save_checkpoint, load_checkpoint, latest_checkpoint,
+)
+from bigdl_tpu.utils.metrics import Metrics
+from bigdl_tpu.utils.summary import (
+    FileWriter, TrainSummary, ValidationSummary, crc32c,
+)

@@ -1,0 +1,94 @@
+"""Checkpoint save/load.
+
+Reference: ``Optimizer.setCheckpoint(path, trigger)`` saves
+``model.<neval>`` + ``optimMethod-<name>.<neval>`` via ``File.save``
+(``DistriOptimizer.scala:505-531``, ``utils/File.scala``); resume =
+``Module.load`` + ``OptimMethod.load``; epoch-position state lives in the
+OptimMethod state table so training resumes mid-epoch
+(``DistriOptimizer.scala:124-134,442-450``).
+
+Here a checkpoint is one file holding (params, model_state, opt_state,
+driver_state) as numpy pytrees — device arrays are pulled to host on save
+and restored with ``jnp.asarray`` on load.  Local filesystem only (the
+reference's HDFS/S3 paths have no analog in this environment).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _to_device(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)
+
+
+def save_checkpoint(path: str, params, model_state=None, opt_state=None,
+                    driver_state: Optional[dict] = None,
+                    neval: Optional[int] = None,
+                    overwrite: bool = True) -> str:
+    """Write a checkpoint.  With ``neval``, the file is ``model.<neval>``
+    inside ``path`` (reference naming); else ``path`` itself."""
+    if neval is not None:
+        os.makedirs(path, exist_ok=True)
+        fname = os.path.join(path, f"model.{neval}")
+    else:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fname = path
+    if os.path.exists(fname) and not overwrite:
+        raise FileExistsError(
+            f"{fname} exists (reference: overWriteCheckpoint not set)")
+    blob = {
+        "version": 1,
+        "params": _to_host(params),
+        "model_state": _to_host(model_state) if model_state is not None else None,
+        "opt_state": _to_host(opt_state) if opt_state is not None else None,
+        "driver_state": dict(driver_state) if driver_state else None,
+    }
+    tmp = fname + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, fname)  # atomic: a crash never leaves a torn checkpoint
+    return fname
+
+
+def load_checkpoint(path: str):
+    """Load a checkpoint written by :func:`save_checkpoint`.  Returns a dict
+    with params/model_state/opt_state/driver_state (device arrays)."""
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    return {
+        "params": _to_device(blob["params"]),
+        "model_state": _to_device(blob["model_state"])
+        if blob["model_state"] is not None else None,
+        "opt_state": _to_device(blob["opt_state"])
+        if blob["opt_state"] is not None else None,
+        "driver_state": blob["driver_state"],
+    }
+
+
+def latest_checkpoint(folder: str) -> Optional[str]:
+    """Find the highest-neval ``model.N`` file (reference retry-from-latest,
+    ``DistriOptimizer.scala:981-1061``)."""
+    if not os.path.isdir(folder):
+        return None
+    best, best_n = None, -1
+    for f in os.listdir(folder):
+        if f.startswith("model."):
+            try:
+                n = int(f.split(".", 1)[1])
+            except ValueError:
+                continue
+            if n > best_n:
+                best, best_n = os.path.join(folder, f), n
+    return best

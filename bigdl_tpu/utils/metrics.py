@@ -1,0 +1,49 @@
+"""Metrics — named training-loop phase counters.
+
+Reference: ``DL/optim/Metrics.scala:31`` — named counters backed by Spark
+accumulators, printed by ``summary()``; the built-in profiling of the
+training loop.  Here: plain host-side aggregation (one process per host;
+cross-host aggregation would ride jax collectives if ever needed).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class Metrics:
+    def __init__(self):
+        self._sums = defaultdict(float)
+        self._counts = defaultdict(int)
+
+    def add(self, name: str, value: float) -> None:
+        self._sums[name] += value
+        self._counts[name] += 1
+
+    @contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def value(self, name: str) -> float:
+        return self._sums[name]
+
+    def mean(self, name: str) -> float:
+        c = self._counts[name]
+        return self._sums[name] / c if c else 0.0
+
+    def summary(self) -> str:
+        """(reference ``Metrics.summary`` printed at
+        ``DistriOptimizer.scala:393``)"""
+        parts = [f"{k}: sum={self._sums[k]:.4f} mean={self.mean(k):.4f} "
+                 f"n={self._counts[k]}" for k in sorted(self._sums)]
+        return "\n".join(parts)
+
+    def reset(self) -> None:
+        self._sums.clear()
+        self._counts.clear()

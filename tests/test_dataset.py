@@ -1,0 +1,145 @@
+"""Data-pipeline tests (reference: dataset specs + transformer specs)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (
+    DataSet, LocalDataSet, DistributedDataSet, Sample, MiniBatch,
+    PaddingParam, SampleToMiniBatch, FnTransformer, batch_samples,
+)
+from bigdl_tpu.dataset import image, mnist
+
+
+def make_samples(n=10):
+    return [Sample(np.full((4,), i, np.float32), np.int32(i % 2))
+            for i in range(n)]
+
+
+class TestLocalDataSet:
+    def test_infinite_train_iterator(self):
+        ds = LocalDataSet(make_samples(5))
+        it = ds.data(train=True)
+        seen = [next(it).label for _ in range(12)]
+        assert len(seen) == 12  # wraps past size
+
+    def test_eval_iterator_one_pass(self):
+        ds = LocalDataSet(make_samples(5))
+        assert len(list(ds.data(train=False))) == 5
+
+    def test_shuffle_permutes_indices_only(self):
+        ds = LocalDataSet(make_samples(100))
+        before = [next(ds.data(train=True)).feature[0] for _ in range(1)]
+        ds.shuffle()
+        order = [s.feature[0] for s in ds.data(train=False)]
+        assert order == sorted(order)  # eval order untouched by shuffle
+
+
+class TestDistributedDataSet:
+    def test_shards_partition_indices(self):
+        data = make_samples(8)
+        shards = []
+        for p in range(4):
+            ds = DistributedDataSet(data, process_index=p, process_count=4)
+            shards.append([s.feature[0] for s in ds.data(train=False)])
+        flat = sorted(x for sh in shards for x in sh)
+        assert flat == [float(i) for i in range(8)]
+        assert all(len(sh) == 2 for sh in shards)
+
+    def test_same_seed_same_permutation(self):
+        data = make_samples(16)
+        a = DistributedDataSet(data, seed=3, process_index=0, process_count=2)
+        b = DistributedDataSet(data, seed=3, process_index=0, process_count=2)
+        a.shuffle(), b.shuffle()
+        assert np.array_equal(a._global_indexes, b._global_indexes)
+
+
+class TestSampleToMiniBatch:
+    def test_batching(self):
+        ds = LocalDataSet(make_samples(10)) >> SampleToMiniBatch(4)
+        batches = list(ds.data(train=False))
+        assert len(batches) == 2  # drop_remainder
+        assert batches[0].input.shape == (4, 4)
+        assert batches[0].target.shape == (4,)
+
+    def test_keep_remainder(self):
+        ds = LocalDataSet(make_samples(10)) >> SampleToMiniBatch(
+            4, drop_remainder=False)
+        assert [b.size() for b in ds.data(train=False)] == [4, 4, 2]
+
+    def test_padding(self):
+        samples = [Sample(np.ones((3, 2), np.float32), np.int32(0)),
+                   Sample(np.ones((5, 2), np.float32), np.int32(1))]
+        mb = batch_samples(samples, feature_padding=PaddingParam(0.0))
+        assert mb.input.shape == (2, 5, 2)
+        np.testing.assert_allclose(mb.input[0, 3:], 0.0)
+
+    def test_ragged_without_padding_raises(self):
+        samples = [Sample(np.ones((3,), np.float32)),
+                   Sample(np.ones((5,), np.float32))]
+        with pytest.raises(ValueError):
+            batch_samples(samples)
+
+    def test_minibatch_slice(self):
+        mb = MiniBatch(np.arange(12).reshape(6, 2), np.arange(6))
+        sub = mb.slice(2, 3)
+        assert sub.size() == 3
+        np.testing.assert_allclose(sub.target, [2, 3, 4])
+
+
+class TestTransformChaining:
+    def test_chained_pipeline(self):
+        imgs, labels = mnist.synthetic_mnist(32, seed=1)
+        samples = mnist.to_samples(imgs, labels)
+        ds = (DataSet.array(samples)
+              >> image.BytesToGreyImg()
+              >> image.GreyImgNormalizer(mnist.TRAIN_MEAN, mnist.TRAIN_STD)
+              >> image.GreyImgToSample()
+              >> SampleToMiniBatch(8))
+        batch = next(ds.data(train=False))
+        assert batch.input.shape == (8, 1, 28, 28)
+        assert abs(float(batch.input.mean())) < 2.0  # roughly normalized
+
+    def test_fn_transformer(self):
+        ds = LocalDataSet(make_samples(4)) >> FnTransformer(
+            lambda s: Sample(s.feature * 2, s.label))
+        out = list(ds.data(train=False))
+        np.testing.assert_allclose(out[1].feature, 2.0)
+
+
+class TestImageOps:
+    def test_random_cropper_pad(self):
+        s = Sample(np.ones((32, 32, 3), np.float32), np.int32(0))
+        out = image.RandomCropper(32, 32, pad=4)._map(s)
+        assert out.feature.shape == (32, 32, 3)
+
+    def test_hflip(self):
+        f = np.arange(6, dtype=np.float32).reshape(2, 3)
+        s = image.HFlip(threshold=1.1)._map(Sample(f, None))  # always flip
+        np.testing.assert_allclose(s.feature[:, 0], [2, 5])
+
+    def test_channel_order(self):
+        s = Sample(np.zeros((8, 8, 3), np.float32), None)
+        assert image.ChannelOrder("CHW")._map(s).feature.shape == (3, 8, 8)
+
+
+class TestMnist:
+    def test_synthetic_learnable_shapes(self):
+        imgs, labels = mnist.synthetic_mnist(64)
+        assert imgs.shape == (64, 28, 28) and imgs.dtype == np.uint8
+        assert labels.shape == (64,)
+        assert len(np.unique(labels)) > 2
+
+    def test_idx_roundtrip(self, tmp_path):
+        import struct
+        imgs = np.random.default_rng(0).integers(
+            0, 255, (3, 28, 28)).astype(np.uint8)
+        labels = np.array([1, 2, 3], np.uint8)
+        with open(tmp_path / "train-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 3, 28, 28))
+            f.write(imgs.tobytes())
+        with open(tmp_path / "train-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 2049, 3))
+            f.write(labels.tobytes())
+        ri, rl = mnist.load_mnist(str(tmp_path), train=True)
+        np.testing.assert_array_equal(ri, imgs)
+        np.testing.assert_array_equal(rl, [1, 2, 3])

@@ -1,0 +1,161 @@
+"""Optim-method / schedule / trigger unit tests (reference ``TEST/optim/``:
+``SGDSpec``, ``AdamSpec``, …)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import optim
+
+
+def rosenbrock_like():
+    """Simple quadratic: f(x) = sum((x - 3)^2); min at 3."""
+    target = 3.0
+
+    def grad(params):
+        return jax.tree_util.tree_map(lambda p: 2 * (p - target), params)
+
+    return grad, target
+
+
+@pytest.mark.parametrize("method,steps,lr_tol", [
+    (optim.SGD(learning_rate=0.1), 100, 1e-3),
+    (optim.SGD(learning_rate=0.05, momentum=0.9), 150, 1e-2),
+    (optim.SGD(learning_rate=0.05, momentum=0.9, dampening=0.0,
+               nesterov=True), 150, 1e-2),
+    (optim.Adam(learning_rate=0.3), 200, 1e-2),
+    (optim.Adagrad(learning_rate=1.0), 300, 1e-2),
+    (optim.Adadelta(decay_rate=0.9), 2000, 0.5),
+    (optim.Adamax(learning_rate=0.5), 200, 1e-2),
+    (optim.RMSprop(learning_rate=0.1), 300, 1e-2),
+])
+def test_methods_converge_on_quadratic(method, steps, lr_tol):
+    grad_fn, target = rosenbrock_like()
+    params = {"w": jnp.array([0.0, 1.0]), "b": jnp.array([5.0])}
+    state = method.init_state(params)
+    for t in range(steps):
+        g = grad_fn(params)
+        params, state = method.update(g, params, state, method.learning_rate, t)
+    for leaf in jax.tree_util.tree_leaves(params):
+        np.testing.assert_allclose(leaf, target, atol=lr_tol * 10)
+
+
+def test_ftrl_sparsifies():
+    m = optim.Ftrl(learning_rate=0.5, l1_regularization_strength=2.0)
+    params = {"w": jnp.array([0.05, -0.02])}  # tiny weights, strong l1
+    state = m.init_state(params)
+    for t in range(50):
+        g = {"w": 0.1 * params["w"]}  # weak pull
+        params, state = m.update(g, params, state, m.learning_rate, t)
+    np.testing.assert_allclose(params["w"], 0.0, atol=1e-6)
+
+
+def test_weight_decay_shrinks():
+    m = optim.SGD(learning_rate=0.1, weight_decay=0.5)
+    params = {"w": jnp.array([2.0])}
+    state = m.init_state(params)
+    params, _ = m.update({"w": jnp.array([0.0])}, params, state, 0.1, 0)
+    assert float(params["w"][0]) < 2.0
+
+
+class TestSchedules:
+    def test_step(self):
+        s = optim.Step(10, 0.5)
+        assert s(1.0, 0, 0) == 1.0
+        assert s(1.0, 10, 0) == 0.5
+        assert s(1.0, 25, 0) == 0.25
+
+    def test_multistep(self):
+        s = optim.MultiStep([5, 15], 0.1)
+        assert s(1.0, 4, 0) == 1.0
+        np.testing.assert_allclose(s(1.0, 5, 0), 0.1)
+        np.testing.assert_allclose(s(1.0, 15, 0), 0.01)
+
+    def test_poly(self):
+        s = optim.Poly(0.5, 100)
+        assert s(1.0, 0, 0) == 1.0
+        np.testing.assert_allclose(s(1.0, 75, 0), 0.5)
+        assert s(1.0, 100, 0) == 0.0
+
+    def test_warmup_then_sequential(self):
+        # ResNet recipe: warmup 5 iters 0.1->0.6, then poly
+        seq = optim.SequentialSchedule(optim.Warmup(0.1, 5),
+                                       optim.Poly(2.0, 100))
+        np.testing.assert_allclose(seq(0.1, 0, 0), 0.1)
+        np.testing.assert_allclose(seq(0.1, 5, 0), 0.1)  # poly iter 0 of base
+        assert seq(0.1, 4, 0) > seq(0.1, 0, 0)
+
+    def test_epoch_schedule_regimes(self):
+        s = optim.EpochSchedule([(0, 2, 1e-2), (3, 6, 1e-3), (7, 100, 1e-4)])
+        assert s(1.0, 0, 1) == 1e-2
+        assert s(1.0, 0, 5) == 1e-3
+        assert s(1.0, 0, 50) == 1e-4
+
+    def test_plateau_drops_on_stall(self):
+        s = optim.Plateau(factor=0.1, patience=2, mode="min")
+        lrs = [s(1.0, i, 0, metric=5.0) for i in range(5)]
+        # i=0 sets best; i=1,2 stall -> drop; i=3,4 stall -> second drop
+        assert lrs[0] == 1.0
+        assert lrs[2] == pytest.approx(0.1)
+        assert lrs[4] == pytest.approx(0.01)
+        # improvement resets the wait counter
+        s2 = optim.Plateau(factor=0.1, patience=2, mode="min")
+        vals = [5.0, 4.0, 3.0, 2.0, 1.0]
+        lrs2 = [s2(1.0, i, 0, metric=v) for i, v in enumerate(vals)]
+        assert all(lr == 1.0 for lr in lrs2)
+
+    def test_default_decay(self):
+        s = optim.Default(0.1)
+        np.testing.assert_allclose(s(1.0, 10, 0), 1.0 / 2.0)
+
+
+class TestTriggers:
+    def test_max_epoch_and_iteration(self):
+        assert optim.max_epoch(5)({"epoch": 5})
+        assert not optim.max_epoch(5)({"epoch": 4})
+        assert optim.max_iteration(10)({"neval": 10})
+
+    def test_every_epoch_and_several_iteration(self):
+        assert optim.every_epoch()({"epoch_finished": True})
+        assert not optim.every_epoch()({"epoch_finished": False})
+        t = optim.several_iteration(3)
+        assert [t({"neval": i}) for i in range(1, 7)] == \
+            [False, False, True, False, False, True]
+
+    def test_composition(self):
+        t = optim.max_epoch(2).or_(optim.min_loss(0.1))
+        assert t({"epoch": 0, "loss": 0.05})
+        assert t({"epoch": 2, "loss": 9.0})
+        assert not t({"epoch": 1, "loss": 1.0})
+
+
+class TestValidationMethods:
+    def test_top1(self):
+        out = jnp.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+        target = jnp.array([0, 1, 1])
+        r = optim.Top1Accuracy()(out, target)
+        np.testing.assert_allclose(r.result, 2 / 3)
+
+    def test_top5(self):
+        out = jax.nn.one_hot(jnp.array([3, 9]), 10) * 5.0
+        # target 3 in top5 trivially; target 0 for second row is not top-1
+        r = optim.Top5Accuracy()(out, jnp.array([3, 0]))
+        assert r.result >= 0.5
+
+    def test_result_associative(self):
+        a = optim.ValidationResult(3, 4)
+        b = optim.ValidationResult(1, 4)
+        np.testing.assert_allclose((a + b).result, 0.5)
+
+    def test_hit_ratio_ndcg(self):
+        # positive score highest -> hit, ndcg=1
+        out = jnp.array([[5.0] + [1.0] * 20])
+        assert optim.HitRatio(10)(out, None).result == 1.0
+        np.testing.assert_allclose(optim.NDCG(10)(out, None).result, 1.0)
+
+    def test_clip_global_norm(self):
+        g = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+        clipped = optim.clip_by_global_norm(g, 1.0)
+        np.testing.assert_allclose(float(optim.global_norm(clipped)), 1.0,
+                                   rtol=1e-5)
