@@ -1,3 +1,8 @@
 """Model zoo (reference ``DL/models/``)."""
 
 from bigdl_tpu.models.lenet import lenet5
+from bigdl_tpu.models.resnet import resnet_cifar, resnet50
+from bigdl_tpu.models.vgg import vgg_for_cifar10, vgg16
+from bigdl_tpu.models.inception import inception_v1
+from bigdl_tpu.models.rnn import simple_rnn, ptb_model
+from bigdl_tpu.models.autoencoder import autoencoder

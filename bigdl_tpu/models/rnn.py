@@ -1,0 +1,42 @@
+"""Recurrent language models.
+
+Reference: ``DL/models/rnn/SimpleRNN.scala`` (tiny-Shakespeare char RNN)
+and ``DL/example/languagemodel/PTBModel.scala`` (PTB word-level LSTM LM).
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.recurrent import (
+    LSTM, MultiRNNCell, Recurrent, RnnCell, TimeDistributed,
+)
+
+
+def simple_rnn(input_size: int = 128, hidden_size: int = 40,
+               output_size: int = 128) -> nn.Sequential:
+    """Char-level RNN (reference ``SimpleRNN.scala``): one-hot input
+    (N, T, input_size) → Recurrent(RnnCell) → per-step Linear →
+    LogSoftMax."""
+    return (nn.Sequential(name="SimpleRNN")
+            .add(Recurrent(RnnCell(input_size, hidden_size)))
+            .add(TimeDistributed(nn.Linear(hidden_size, output_size)))
+            .add(nn.LogSoftMax()))
+
+
+def ptb_model(vocab_size: int = 10000, embed_dim: int = 200,
+              hidden_size: int = 200, num_layers: int = 2,
+              dropout: float = 0.0) -> nn.Sequential:
+    """PTB word LM (reference ``PTBModel.scala``): embedding → stacked LSTM
+    → per-step Linear → LogSoftMax.  Input: int tokens (N, T)."""
+    cells = [LSTM(embed_dim if i == 0 else hidden_size, hidden_size)
+             for i in range(num_layers)]
+    m = (nn.Sequential(name="PTBModel")
+         .add(nn.LookupTable(vocab_size, embed_dim)))
+    if dropout > 0:
+        m.add(nn.Dropout(dropout))
+    m.add(Recurrent(MultiRNNCell(cells)))
+    if dropout > 0:
+        m.add(nn.Dropout(dropout))
+    m.add(TimeDistributed(nn.Linear(hidden_size, vocab_size)))
+    m.add(nn.LogSoftMax())
+    return m

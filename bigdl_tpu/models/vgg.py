@@ -1,0 +1,62 @@
+"""VGG (reference ``DL/models/vgg/VggForCifar10.scala`` — the CIFAR-10
+VGG-16 with BatchNorm, plus the ImageNet VGG-16/19 of
+``DL/models/utils/DistriOptimizerPerf`` configs)."""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def _conv_bn_relu(model, in_c, out_c):
+    (model
+     .add(nn.SpatialConvolution(in_c, out_c, 3, 3, 1, 1, 1, 1))
+     .add(nn.SpatialBatchNormalization(out_c, eps=1e-3))
+     .add(nn.ReLU()))
+    return out_c
+
+
+def vgg_for_cifar10(class_num: int = 10) -> nn.Sequential:
+    """(reference ``VggForCifar10.scala``: conv stacks 64-128-256-512-512,
+    classifier 512→512→classNum with dropout)."""
+    model = nn.Sequential(name="VggForCifar10")
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    in_c = 3
+    for v in cfg:
+        if v == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            in_c = _conv_bn_relu(model, in_c, v)
+    (model
+     .add(nn.Reshape((512,)))
+     .add(nn.Dropout(0.5))
+     .add(nn.Linear(512, 512))
+     .add(nn.BatchNormalization(512))
+     .add(nn.ReLU())
+     .add(nn.Dropout(0.5))
+     .add(nn.Linear(512, class_num))
+     .add(nn.LogSoftMax()))
+    return model
+
+
+def vgg16(class_num: int = 1000) -> nn.Sequential:
+    """ImageNet VGG-16 (throughput-harness model of
+    ``DistriOptimizerPerf``)."""
+    model = nn.Sequential(name="Vgg16")
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    in_c = 3
+    for v in cfg:
+        if v == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(nn.SpatialConvolution(in_c, v, 3, 3, 1, 1, 1, 1))
+            model.add(nn.ReLU())
+            in_c = v
+    (model
+     .add(nn.Reshape((512 * 7 * 7,)))
+     .add(nn.Linear(512 * 7 * 7, 4096)).add(nn.ReLU()).add(nn.Dropout(0.5))
+     .add(nn.Linear(4096, 4096)).add(nn.ReLU()).add(nn.Dropout(0.5))
+     .add(nn.Linear(4096, class_num))
+     .add(nn.LogSoftMax()))
+    return model

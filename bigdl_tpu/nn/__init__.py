@@ -42,3 +42,8 @@ from bigdl_tpu.nn.criterion import (
     ParallelCriterion, TimeDistributedCriterion, PGCriterion,
     MultiLabelMarginCriterion, SoftmaxWithCriterion,
 )
+from bigdl_tpu.nn.graph import Graph, Input, Node
+from bigdl_tpu.nn.recurrent import (
+    Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole, MultiRNNCell,
+    Recurrent, BiRecurrent, RecurrentDecoder, TimeDistributed,
+)

@@ -1,0 +1,146 @@
+"""Graph — DAG models.
+
+Reference: ``DL/nn/Graph.scala:72`` (742 LoC) + ``StaticGraph.scala`` —
+models built from ``Node[AbstractModule]`` with a precomputed
+``topologySort`` and a *generated backward graph* (``Graph.scala:196``).
+
+TPU redesign: the backward graph dies (jax.grad differentiates the forward
+trace); what remains is a declarative DAG executed in topological order
+inside ``apply``.  The reference's ``DynamicGraph``+``Scheduler`` execute
+TF-style control-flow frames (Enter/Exit/Switch/Merge,
+``nn/Scheduler.scala:104-145``); under XLA data-dependent control flow maps
+to ``lax.cond``/``lax.while_loop`` inside a module's ``apply`` instead of
+graph-level scheduling, so only the static DAG is needed here.
+
+Usage (mirrors the reference's functional graph API)::
+
+    inp = Input()
+    h = Linear(4, 8)(inp)          # Module.__call__ on Node -> Node
+    a = ReLU()(h)
+    b = Tanh()(h)
+    out = CAddTable()([a, b])      # multi-input: list of Nodes
+    model = Graph([inp], [out])
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+
+from bigdl_tpu.nn.module import Module
+
+
+class Node:
+    """A module instance + its input edges."""
+
+    __slots__ = ("module", "inputs")
+
+    def __init__(self, module: Optional[Module], inputs: Sequence["Node"]):
+        self.module = module
+        self.inputs = list(inputs)
+
+    def __repr__(self):
+        name = self.module.name if self.module else "Input"
+        return f"Node({name})"
+
+
+class Input(Node):
+    """Graph input placeholder (reference ``nn/Input.scala``)."""
+
+    def __init__(self):
+        super().__init__(None, [])
+
+
+class Graph(Module):
+    """Static DAG container (reference ``StaticGraph.scala:35``).
+
+    The ``module(node)`` call syntax that builds :class:`Node` edges is
+    implemented in ``Module.__call__`` (module.py) via a Node isinstance
+    check.
+
+    **Weight sharing:** using the SAME module instance at several graph
+    positions ties the weights (reference semantics — a module owns its
+    weights), implemented by keying params by the module's first
+    occurrence."""
+
+    def __init__(self, inputs: Sequence[Node], outputs: Sequence[Node],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_nodes = list(inputs)
+        self.output_nodes = list(outputs)
+        self._order = self._topo_sort()
+        # modules in execution order (Input nodes excluded)
+        self.modules = [n.module for n in self._order]
+        # param key per node: nodes sharing a module instance share params
+        self._param_keys: list[str] = []
+        first_seen: dict[int, str] = {}
+        for i, n in enumerate(self._order):
+            key = first_seen.setdefault(id(n.module), str(i))
+            self._param_keys.append(key)
+
+    def _topo_sort(self) -> list[Node]:
+        """Reverse-DFS from outputs (reference ``forwardGraph.topologySort``,
+        ``StaticGraph.scala:41``)."""
+        visited: dict[int, int] = {}  # id -> 0 visiting, 1 done
+        order: list[Node] = []
+
+        def visit(n: Node):
+            key = id(n)
+            st = visited.get(key)
+            if st == 1:
+                return
+            if st == 0:
+                raise ValueError("graph contains a cycle")
+            visited[key] = 0
+            for p in n.inputs:
+                visit(p)
+            visited[key] = 1
+            if n.module is not None:
+                order.append(n)
+            elif n not in self.input_nodes:
+                raise ValueError("dangling Input node not listed in inputs")
+
+        for out in self.output_nodes:
+            visit(out)
+        return order
+
+    def init(self, rng):
+        params, state = {}, {}
+        for i, node in enumerate(self._order):
+            key = self._param_keys[i]
+            if key in params:  # shared module: weights tied
+                continue
+            rng, sub = jax.random.split(rng)
+            p, s = node.module.init(sub)
+            params[key] = p
+            state[key] = s
+        return params, state
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        # bind graph inputs
+        values: dict[int, object] = {}
+        if len(self.input_nodes) == 1:
+            values[id(self.input_nodes[0])] = input
+        else:
+            if len(input) != len(self.input_nodes):
+                raise ValueError(
+                    f"graph expects {len(self.input_nodes)} inputs, "
+                    f"got {len(input)}")
+            for node, x in zip(self.input_nodes, input):
+                values[id(node)] = x
+
+        rngs = ([None] * len(self._order) if rng is None
+                else list(jax.random.split(rng, len(self._order))))
+        new_state = {}
+        for i, node in enumerate(self._order):
+            key = self._param_keys[i]
+            args = [values[id(p)] for p in node.inputs]
+            x = args[0] if len(args) == 1 else tuple(args)
+            out, s = node.module.apply(params[key], state[key], x,
+                                       training=training, rng=rngs[i])
+            values[id(node)] = out
+            new_state[key] = s
+
+        outs = [values[id(n)] for n in self.output_nodes]
+        return (outs[0] if len(outs) == 1 else tuple(outs)), new_state

@@ -84,6 +84,14 @@ class Module:
         return out
 
     def __call__(self, input, rng: Optional[jax.Array] = None):
+        # functional-graph syntax: calling a module on Node(s) builds a DAG
+        # edge instead of running eagerly (see nn/graph.py)
+        from bigdl_tpu.nn.graph import Node
+        if isinstance(input, Node) or (
+                isinstance(input, (list, tuple)) and input
+                and all(isinstance(e, Node) for e in input)):
+            prev = [input] if isinstance(input, Node) else list(input)
+            return Node(self, prev)
         return self.forward(input, rng=rng)
 
     def backward(self, input, grad_output, rng: Optional[jax.Array] = None):

@@ -1,0 +1,364 @@
+"""Recurrent stack.
+
+Reference: ``DL/nn/Recurrent.scala`` (855 LoC) unrolls a ``Cell`` over time
+with cloned cells sharing weights; ``RecurrentDecoder`` feeds output back as
+input; plus ``RnnCell``/``LSTM``/``LSTMPeephole``/``GRU``/
+``ConvLSTMPeephole``/``MultiRNNCell``/``BiRecurrent``/``TimeDistributed``.
+
+TPU redesign: **unrolling becomes ``lax.scan``** — one compiled step body,
+weights naturally shared, sequence dim handled by XLA (no cloned cells, no
+hidden-state plumbing between mutable modules).  This is the SURVEY §7 risk
+item "Recurrent/dynamic shapes under XLA": static max-length sequences +
+masking, never data-dependent python loops.
+
+Layout: batch-major ``(N, T, features)`` like the reference's default
+(batchNormParams aside).  Cells are stateless modules whose ``apply`` takes
+``(x_t, hidden)`` packed as a tuple and returns ``(out_t, new_hidden)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.initialization import RandomUniform, InitializationMethod
+
+
+def _cast_hidden(hidden, dtype):
+    """Match the hidden state to the input dtype so bf16 mixed precision
+    flows through the scan (an f32 hidden would promote every step's
+    concat/matmul back to f32, silently disabling the MXU speedup)."""
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return hidden
+    return jax.tree_util.tree_map(lambda h: h.astype(dtype), hidden)
+
+
+class Cell(Module):
+    """Recurrent cell contract: ``step(params, x_t, hidden) -> (y_t, hidden)``
+    plus ``initial_hidden(batch)``."""
+
+    hidden_size: int
+
+    def initial_hidden(self, batch_size: int):
+        raise NotImplementedError
+
+    def step(self, params, x_t, hidden):
+        raise NotImplementedError
+
+    # a Cell used standalone acts on one timestep: input=(x_t, hidden)
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x_t, hidden = input
+        y, new_hidden = self.step(params, x_t, hidden)
+        return (y, new_hidden), state
+
+
+def _uniform(rng, shape, fan_in):
+    return RandomUniform().init(rng, shape, fan_in, fan_in)
+
+
+class RnnCell(Cell):
+    """Elman RNN: h' = act(W x + U h + b) (reference ``RNN.scala``
+    RnnCell; default Tanh activation)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation=jnp.tanh, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        fan = self.input_size + self.hidden_size
+        return {"w_ih": _uniform(k1, (self.hidden_size, self.input_size), fan),
+                "w_hh": _uniform(k2, (self.hidden_size, self.hidden_size), fan),
+                "bias": _uniform(k3, (self.hidden_size,), fan)}, {}
+
+    def initial_hidden(self, batch_size: int):
+        return jnp.zeros((batch_size, self.hidden_size), jnp.float32)
+
+    def step(self, params, x_t, h):
+        h_new = self.activation(x_t @ params["w_ih"].T + h @ params["w_hh"].T
+                                + params["bias"])
+        return h_new, h_new
+
+
+class LSTM(Cell):
+    """LSTM cell (reference ``LSTM.scala``): gates i,f,g,o from one fused
+    projection of [x, h] — a single MXU matmul per step."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 forget_bias: float = 0.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.forget_bias = forget_bias
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        H, D = self.hidden_size, self.input_size
+        fan = D + H
+        w = _uniform(k1, (4 * H, D + H), fan)
+        b = _uniform(k2, (4 * H,), fan)
+        return {"weight": w, "bias": b}, {}
+
+    def initial_hidden(self, batch_size: int):
+        H = self.hidden_size
+        return (jnp.zeros((batch_size, H), jnp.float32),
+                jnp.zeros((batch_size, H), jnp.float32))
+
+    def step(self, params, x_t, hidden):
+        h, c = hidden
+        H = self.hidden_size
+        z = jnp.concatenate([x_t, h], axis=-1) @ params["weight"].T \
+            + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + self.forget_bias)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections (reference ``LSTMPeephole.scala``)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        H, D = self.hidden_size, self.input_size
+        fan = D + H
+        return {"weight": _uniform(k1, (4 * H, D + H), fan),
+                "bias": _uniform(k2, (4 * H,), fan),
+                "peep": _uniform(k3, (3, H), fan)}, {}
+
+    def initial_hidden(self, batch_size: int):
+        H = self.hidden_size
+        return (jnp.zeros((batch_size, H), jnp.float32),
+                jnp.zeros((batch_size, H), jnp.float32))
+
+    def step(self, params, x_t, hidden):
+        h, c = hidden
+        z = jnp.concatenate([x_t, h], axis=-1) @ params["weight"].T \
+            + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        p = params["peep"]
+        i = jax.nn.sigmoid(i + p[0] * c)
+        f = jax.nn.sigmoid(f + p[1] * c)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        o = jax.nn.sigmoid(o + p[2] * c_new)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRU(Cell):
+    """GRU cell (reference ``GRU.scala``)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    def init(self, rng):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        H, D = self.hidden_size, self.input_size
+        fan = D + H
+        return {"w_gates": _uniform(k1, (2 * H, D + H), fan),
+                "b_gates": _uniform(k2, (2 * H,), fan),
+                "w_cand": _uniform(k3, (H, D + H), fan),
+                "b_cand": _uniform(k4, (H,), fan)}, {}
+
+    def initial_hidden(self, batch_size: int):
+        return jnp.zeros((batch_size, self.hidden_size), jnp.float32)
+
+    def step(self, params, x_t, h):
+        z = jnp.concatenate([x_t, h], axis=-1) @ params["w_gates"].T \
+            + params["b_gates"]
+        r, u = jnp.split(jax.nn.sigmoid(z), 2, axis=-1)
+        cand = jnp.tanh(jnp.concatenate([x_t, r * h], axis=-1)
+                        @ params["w_cand"].T + params["b_cand"])
+        h_new = u * h + (1 - u) * cand
+        return h_new, h_new
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM over NCHW feature maps (reference
+    ``ConvLSTMPeephole.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int, kernel: int = 3,
+                 spatial: Optional[tuple[int, int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+        self.kernel = kernel
+        self.spatial = spatial  # (H, W), required for initial_hidden
+        self.hidden_size = output_size
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        C_in, C_out, K = self.input_size, self.output_size, self.kernel
+        fan = (C_in + C_out) * K * K
+        w = _uniform(k1, (4 * C_out, C_in + C_out, K, K), fan)
+        b = _uniform(k2, (4 * C_out,), fan)
+        return {"weight": w, "bias": b}, {}
+
+    def initial_hidden(self, batch_size: int):
+        assert self.spatial is not None, \
+            "ConvLSTMPeephole needs spatial=(H, W) for initial hidden"
+        H, W = self.spatial
+        shape = (batch_size, self.output_size, H, W)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+    def step(self, params, x_t, hidden):
+        h, c = hidden
+        z = lax.conv_general_dilated(
+            jnp.concatenate([x_t, h], axis=1), params["weight"],
+            window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        z = z + params["bias"][None, :, None, None]
+        i, f, g, o = jnp.split(z, 4, axis=1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class MultiRNNCell(Cell):
+    """Stack cells vertically (reference ``MultiRNNCell.scala``)."""
+
+    def __init__(self, cells: Sequence[Cell], name: Optional[str] = None):
+        super().__init__(name)
+        self.cells = list(cells)
+        self.hidden_size = self.cells[-1].hidden_size
+
+    def init(self, rng):
+        params = {}
+        for i, c in enumerate(self.cells):
+            rng, sub = jax.random.split(rng)
+            p, _ = c.init(sub)
+            params[str(i)] = p
+        return params, {}
+
+    def initial_hidden(self, batch_size: int):
+        return tuple(c.initial_hidden(batch_size) for c in self.cells)
+
+    def step(self, params, x_t, hidden):
+        new_hidden = []
+        out = x_t
+        for i, c in enumerate(self.cells):
+            out, h = c.step(params[str(i)], out, hidden[i])
+            new_hidden.append(h)
+        return out, tuple(new_hidden)
+
+
+class Recurrent(Module):
+    """Run a Cell over the time dim of (N, T, ...) via ``lax.scan``
+    (reference ``Recurrent.scala``; returns the full output sequence)."""
+
+    def __init__(self, cell: Cell, reverse: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.cell = cell
+        self.reverse = reverse
+
+    def init(self, rng):
+        return self.cell.init(rng)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        N = input.shape[0]
+        hidden0 = _cast_hidden(self.cell.initial_hidden(N), input.dtype)
+        xs = jnp.moveaxis(input, 1, 0)  # (T, N, ...) scan-major
+        if self.reverse:
+            xs = jnp.flip(xs, axis=0)
+
+        def body(hidden, x_t):
+            y, new_hidden = self.cell.step(params, x_t, hidden)
+            return new_hidden, y
+
+        _, ys = lax.scan(body, hidden0, xs)
+        if self.reverse:
+            ys = jnp.flip(ys, axis=0)
+        return jnp.moveaxis(ys, 0, 1), state  # back to (N, T, ...)
+
+
+class BiRecurrent(Module):
+    """Bidirectional wrapper (reference ``BiRecurrent.scala``; merge =
+    concat on the feature dim by default, or 'add')."""
+
+    def __init__(self, cell_fwd: Cell, cell_bwd: Optional[Cell] = None,
+                 merge: str = "concat", name: Optional[str] = None):
+        super().__init__(name)
+        import copy
+        self.fwd = Recurrent(cell_fwd)
+        self.bwd = Recurrent(cell_bwd if cell_bwd is not None
+                             else copy.deepcopy(cell_fwd), reverse=True)
+        self.merge = merge
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        pf, _ = self.fwd.init(k1)
+        pb, _ = self.bwd.init(k2)
+        return {"fwd": pf, "bwd": pb}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        yf, _ = self.fwd.apply(params["fwd"], {}, input, training=training)
+        yb, _ = self.bwd.apply(params["bwd"], {}, input, training=training)
+        if self.merge == "concat":
+            return jnp.concatenate([yf, yb], axis=-1), state
+        return yf + yb, state
+
+
+class RecurrentDecoder(Module):
+    """Decode ``seq_length`` steps feeding each output back as the next
+    input (reference ``RecurrentDecoder.scala``).  Input: the first-step
+    input (N, features)."""
+
+    def __init__(self, cell: Cell, seq_length: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.cell = cell
+        self.seq_length = seq_length
+
+    def init(self, rng):
+        return self.cell.init(rng)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        N = input.shape[0]
+        hidden0 = _cast_hidden(self.cell.initial_hidden(N), input.dtype)
+
+        def body(carry, _):
+            x, hidden = carry
+            y, new_hidden = self.cell.step(params, x, hidden)
+            return (y, new_hidden), y
+
+        _, ys = lax.scan(body, (input, hidden0), None,
+                         length=self.seq_length)
+        return jnp.moveaxis(ys, 0, 1), state
+
+
+class TimeDistributed(Module):
+    """Apply an inner module independently at each timestep of (N, T, ...)
+    (reference ``TimeDistributed.scala``) by folding time into batch —
+    XLA sees one big batched op instead of T small ones."""
+
+    def __init__(self, layer: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self.layer = layer
+
+    def init(self, rng):
+        return self.layer.init(rng)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        N, T = input.shape[0], input.shape[1]
+        flat = input.reshape((N * T,) + input.shape[2:])
+        out, new_state = self.layer.apply(params, state, flat,
+                                          training=training, rng=rng)
+        return out.reshape((N, T) + out.shape[1:]), new_state

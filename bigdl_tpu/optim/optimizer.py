@@ -98,6 +98,7 @@ class Optimizer:
                             "records_processed_this_epoch": 0}
         self._eval_fwd = None  # cached jit'd eval forward
         self._resume_opt_state = None  # optimizer state restored on retry
+        self.compute_dtype = None  # None = full f32; jnp.bfloat16 for MXU
 
     # ------------------------------------------------------------- builder
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -150,6 +151,12 @@ class Optimizer:
         self.seed = seed
         return self
 
+    def set_compute_dtype(self, dtype) -> "Optimizer":
+        """Mixed precision: fwd/bwd in ``dtype`` (bf16 for the MXU), master
+        params + optimizer update stay f32.  See utils/precision.py."""
+        self.compute_dtype = dtype
+        return self
+
     def set_state(self, state: dict) -> "Optimizer":
         """Resume driver state (epoch/neval) from a checkpoint."""
         self.state.update(state)
@@ -172,11 +179,15 @@ class Optimizer:
     # ------------------------------------------------------------- shared
     def _loss_and_grad_fn(self):
         model, criterion = self.model, self.criterion
-
-        def loss_fn(params, mstate, x, y, rng):
-            out, new_mstate = model.apply(params, mstate, x,
-                                          training=True, rng=rng)
-            return criterion.apply(out, y), new_mstate
+        if self.compute_dtype is not None:
+            from bigdl_tpu.utils.precision import mixed_precision_loss_fn
+            loss_fn = mixed_precision_loss_fn(model, criterion,
+                                              self.compute_dtype)
+        else:
+            def loss_fn(params, mstate, x, y, rng):
+                out, new_mstate = model.apply(params, mstate, x,
+                                              training=True, rng=rng)
+                return criterion.apply(out, y), new_mstate
 
         return jax.value_and_grad(loss_fn, has_aux=True)
 

@@ -1,0 +1,44 @@
+"""Mixed precision for TPU.
+
+No reference analog (the reference's only precision trick is the FP16 wire
+compression of ``parameters/FP16CompressedTensor.scala``, which ICI makes
+unnecessary) — but bf16 compute is how the MXU reaches peak throughput, so
+the training stack treats it as first-class: **params, optimizer state and
+the update stay f32; forward/backward compute in bf16** (classic mixed
+precision; loss and criterion math in f32 for stable softmax/log).
+
+bf16 needs no loss scaling (same exponent range as f32), unlike fp16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+tmap = jax.tree_util.tree_map
+
+
+def cast_floating(tree, dtype):
+    """Cast only floating leaves of a pytree (ints/bools pass through)."""
+    return tmap(
+        lambda a: a.astype(dtype)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        else a,
+        tree)
+
+
+def mixed_precision_loss_fn(model, criterion, compute_dtype=jnp.bfloat16):
+    """Build a loss fn computing fwd/bwd in ``compute_dtype`` with f32
+    master params and f32 criterion math.  Grads come back f32 (the
+    transpose of the downcast is an upcast)."""
+
+    def loss_fn(params, mstate, x, y, rng):
+        p_c = cast_floating(params, compute_dtype)
+        x_c = cast_floating(x, compute_dtype)
+        out, new_mstate = model.apply(p_c, mstate, x_c, training=True,
+                                      rng=rng)
+        out = cast_floating(out, jnp.float32)
+        new_mstate = cast_floating(new_mstate, jnp.float32)
+        return criterion.apply(out, y), new_mstate
+
+    return loss_fn

@@ -1,0 +1,218 @@
+"""Graph DAG + recurrent stack tests (reference: ``TEST/nn/GraphSpec``,
+``RecurrentSpec``, ``LSTMSpec``, ``GRUSpec``, …)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+
+def rng(i=0):
+    return jax.random.PRNGKey(i)
+
+
+class TestGraph:
+    def test_linear_chain_matches_sequential(self):
+        inp = nn.Input()
+        h = nn.Linear(4, 8)(inp)
+        r = nn.ReLU()(h)
+        out = nn.Linear(8, 2)(r)
+        g = nn.Graph([inp], [out])
+        p, s = g.init(rng(0))
+        x = jax.random.normal(rng(1), (3, 4))
+        y, _ = g.apply(p, s, x)
+        assert y.shape == (3, 2)
+
+    def test_diamond_dag(self):
+        inp = nn.Input()
+        h = nn.Linear(4, 4)(inp)
+        a = nn.ReLU()(h)
+        b = nn.Tanh()(h)
+        out = nn.CAddTable()([a, b])
+        g = nn.Graph([inp], [out])
+        p, s = g.init(rng(0))
+        x = jnp.ones((2, 4))
+        y, _ = g.apply(p, s, x)
+        # check value: relu(h)+tanh(h)
+        h_v, _ = g._order[0].module.apply(p["0"], {}, x)
+        np.testing.assert_allclose(y, jax.nn.relu(h_v) + jnp.tanh(h_v),
+                                   rtol=1e-5)
+
+    def test_multi_input_multi_output(self):
+        i1, i2 = nn.Input(), nn.Input()
+        a = nn.Linear(3, 5)(i1)
+        b = nn.Linear(7, 5)(i2)
+        s = nn.CAddTable()([a, b])
+        m = nn.CMulTable()([a, b])
+        g = nn.Graph([i1, i2], [s, m])
+        p, st = g.init(rng(0))
+        y, _ = g.apply(p, st, (jnp.ones((2, 3)), jnp.ones((2, 7))))
+        assert y[0].shape == (2, 5) and y[1].shape == (2, 5)
+
+    def test_cycle_detection(self):
+        inp = nn.Input()
+        n1 = nn.Linear(2, 2)(inp)
+        n2 = nn.ReLU()(n1)
+        n1.inputs.append(n2)  # introduce cycle
+        with pytest.raises(ValueError, match="cycle"):
+            nn.Graph([inp], [n2])
+
+    def test_graph_under_jit_grad(self):
+        inp = nn.Input()
+        out = nn.Linear(4, 1)(nn.Tanh()(nn.Linear(4, 4)(inp)))
+        g = nn.Graph([inp], [out])
+        p, s = g.init(rng(0))
+        f = jax.jit(lambda p, x: g.apply(p, s, x)[0].sum())
+        gr = jax.grad(f)(p, jnp.ones((5, 4)))
+        assert jax.tree_util.tree_structure(gr) == \
+            jax.tree_util.tree_structure(p)
+
+
+class TestCells:
+    @pytest.mark.parametrize("cell_cls,hidden_tuple", [
+        (nn.RnnCell, False), (nn.LSTM, True), (nn.GRU, False),
+        (nn.LSTMPeephole, True),
+    ])
+    def test_single_step_shapes(self, cell_cls, hidden_tuple):
+        cell = cell_cls(6, 10)
+        p, _ = cell.init(rng(0))
+        h0 = cell.initial_hidden(4)
+        x = jax.random.normal(rng(1), (4, 6))
+        y, h1 = cell.step(p, x, h0)
+        assert y.shape == (4, 10)
+        if hidden_tuple:
+            assert h1[0].shape == (4, 10) and h1[1].shape == (4, 10)
+
+    def test_lstm_gate_semantics(self):
+        """All-zero params: i=f=o=0.5, g=0 → c stays 0, h=0."""
+        cell = nn.LSTM(3, 4)
+        p = {"weight": jnp.zeros((16, 7)), "bias": jnp.zeros((16,))}
+        h0 = cell.initial_hidden(2)
+        y, (h, c) = cell.step(p, jnp.ones((2, 3)), h0)
+        np.testing.assert_allclose(c, 0.0)
+        np.testing.assert_allclose(y, 0.0)
+
+    def test_conv_lstm(self):
+        cell = nn.ConvLSTMPeephole(2, 4, 3, spatial=(8, 8))
+        p, _ = cell.init(rng(0))
+        h0 = cell.initial_hidden(2)
+        y, _ = cell.step(p, jnp.ones((2, 2, 8, 8)), h0)
+        assert y.shape == (2, 4, 8, 8)
+
+
+class TestRecurrent:
+    def test_sequence_output_shape(self):
+        m = nn.Recurrent(nn.LSTM(5, 7))
+        p, s = m.init(rng(0))
+        x = jax.random.normal(rng(1), (3, 11, 5))
+        y, _ = m.apply(p, s, x)
+        assert y.shape == (3, 11, 7)
+
+    def test_scan_matches_manual_unroll(self):
+        cell = nn.GRU(4, 6)
+        p, _ = cell.init(rng(0))
+        m = nn.Recurrent(cell)
+        x = jax.random.normal(rng(1), (2, 5, 4))
+        y, _ = m.apply(p, {}, x)
+        # manual unroll
+        h = cell.initial_hidden(2)
+        outs = []
+        for t in range(5):
+            o, h = cell.step(p, x[:, t], h)
+            outs.append(o)
+        np.testing.assert_allclose(y, jnp.stack(outs, 1), rtol=2e-5,
+                                   atol=1e-6)
+
+    def test_birecurrent_concat(self):
+        m = nn.BiRecurrent(nn.LSTM(4, 6))
+        p, s = m.init(rng(0))
+        y, _ = m.apply(p, s, jnp.ones((2, 7, 4)))
+        assert y.shape == (2, 7, 12)
+
+    def test_recurrent_decoder(self):
+        m = nn.RecurrentDecoder(nn.RnnCell(6, 6), seq_length=9)
+        p, s = m.init(rng(0))
+        y, _ = m.apply(p, s, jnp.ones((3, 6)))
+        assert y.shape == (3, 9, 6)
+
+    def test_multi_rnn_cell_stack(self):
+        stack = nn.MultiRNNCell([nn.LSTM(4, 8), nn.LSTM(8, 6)])
+        m = nn.Recurrent(stack)
+        p, s = m.init(rng(0))
+        y, _ = m.apply(p, s, jnp.ones((2, 5, 4)))
+        assert y.shape == (2, 5, 6)
+
+    def test_time_distributed(self):
+        m = nn.TimeDistributed(nn.Linear(4, 2))
+        p, s = m.init(rng(0))
+        y, _ = m.apply(p, s, jnp.ones((3, 7, 4)))
+        assert y.shape == (3, 7, 2)
+
+    def test_recurrent_trains(self):
+        """A GRU can learn to sum a +1/-1 sequence sign."""
+        model = (nn.Sequential()
+                 .add(nn.Recurrent(nn.GRU(1, 16)))
+                 .add(nn.Select(1, -1))  # last timestep
+                 .add(nn.Linear(16, 2))
+                 .add(nn.LogSoftMax()))
+        p, s = model.init(rng(0))
+        key = rng(42)
+        x = jax.random.choice(key, jnp.array([-1.0, 1.0]), (256, 8, 1))
+        y = (jnp.sum(x[:, :, 0], 1) > 0).astype(jnp.int32)
+        from bigdl_tpu.nn.criterion import ClassNLLCriterion
+        crit = ClassNLLCriterion()
+
+        @jax.jit
+        def step(p, x, y):
+            def loss(p):
+                out, _ = model.apply(p, s, x)
+                return crit.apply(out, y)
+            l, g = jax.value_and_grad(loss)(p)
+            return jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g), l
+
+        for _ in range(60):
+            p, l = step(p, x, y)
+        out, _ = model.apply(p, s, x)
+        acc = float(jnp.mean(jnp.argmax(out, -1) == y))
+        assert acc > 0.9, f"GRU failed to learn parity-of-sum: {acc}"
+
+
+class TestReviewRegressions:
+    def test_shared_module_ties_weights(self):
+        """Reusing one module instance across graph positions shares params
+        (reference semantics: the module owns its weights)."""
+        shared = nn.Linear(4, 4)
+        i1 = nn.Input()
+        a = shared(i1)
+        b = shared(nn.ReLU()(a))  # second use of the same instance
+        g = nn.Graph([i1], [b])
+        p, s = g.init(rng(0))
+        # only ONE param set for the shared Linear
+        linear_keys = [k for k, v in p.items() if "weight" in v]
+        assert len(linear_keys) == 1
+        x = jnp.ones((2, 4))
+        y, _ = g.apply(p, s, x)
+        w, bb = p[linear_keys[0]]["weight"], p[linear_keys[0]]["bias"]
+        expected = jax.nn.relu(x @ w.T + bb) @ w.T + bb
+        np.testing.assert_allclose(y, expected, rtol=1e-5)
+
+    def test_recurrent_bf16_stays_bf16(self):
+        """bf16 input must keep the whole scan in bf16 (MXU path)."""
+        m = nn.Recurrent(nn.LSTM(4, 8))
+        p, s = m.init(rng(0))
+        p16 = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), p)
+        x = jnp.ones((2, 5, 4), jnp.bfloat16)
+        y, _ = m.apply(p16, s, x)
+        assert y.dtype == jnp.bfloat16
+
+    def test_module_call_not_monkeypatched(self):
+        """Node dispatch lives in Module.__call__ itself; eager call still
+        works after graph import."""
+        lin = nn.Linear(3, 2).initialize(0)
+        y = lin(jnp.ones((1, 3)))  # eager
+        assert y.shape == (1, 2)
+        node = lin(nn.Input())  # graph
+        assert isinstance(node, nn.Node)

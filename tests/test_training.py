@@ -254,3 +254,41 @@ class TestReviewRegressions:
         # 128 skipped + 4*32 trained = 256 -> exactly one epoch rollover
         assert opt.state["epoch"] == 1
         assert opt.state["records_processed_this_epoch"] == 0
+
+
+class TestMixedPrecision:
+    def test_bf16_compute_trains(self):
+        train = mnist_pipeline(256, 64)
+        model = small_mlp()
+        opt = (optim.LocalOptimizer(model, train, nn.ClassNLLCriterion())
+               .set_optim_method(optim.Adam(3e-3))
+               .set_compute_dtype(jnp.bfloat16)
+               .set_end_when(optim.max_epoch(6)))
+        opt.optimize()
+        assert opt.state["loss"] < 1.0
+        # master params stay f32
+        for leaf in jax.tree_util.tree_leaves(model._params):
+            assert leaf.dtype == jnp.float32
+
+    def test_bf16_grads_match_f32_direction(self):
+        from bigdl_tpu.utils.precision import mixed_precision_loss_fn
+        model = small_mlp()
+        p, s = model.init(jax.random.PRNGKey(0))
+        crit = nn.ClassNLLCriterion()
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 1, 28, 28))
+        y = jnp.zeros((16,), jnp.int32)
+
+        def f32_loss(p):
+            out, _ = model.apply(p, s, x, training=True)
+            return crit.apply(out, y)
+
+        mp = mixed_precision_loss_fn(model, crit)
+        g32 = jax.grad(f32_loss)(p)
+        g16 = jax.grad(lambda p: mp(p, s, x, y, None)[0])(p)
+        # cosine similarity of flattened grads should be ~1
+        from jax.flatten_util import ravel_pytree
+        a, _ = ravel_pytree(g32)
+        b, _ = ravel_pytree(g16)
+        assert b.dtype == jnp.float32
+        cos = float(jnp.dot(a, b) / (jnp.linalg.norm(a) * jnp.linalg.norm(b)))
+        assert cos > 0.99, cos
