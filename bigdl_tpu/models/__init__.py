@@ -6,3 +6,6 @@ from bigdl_tpu.models.vgg import vgg_for_cifar10, vgg16
 from bigdl_tpu.models.inception import inception_v1
 from bigdl_tpu.models.rnn import simple_rnn, ptb_model
 from bigdl_tpu.models.autoencoder import autoencoder
+from bigdl_tpu.models.transformer import (
+    transformer_lm, transformer_block, LearnedPositionalEmbedding,
+)

@@ -1,0 +1,73 @@
+"""Transformer language model.
+
+No reference analog (BigDL predates transformers) — flagship for the TPU
+build's first-class long-context/distributed capabilities: with
+``shard=True`` the attention and MLP carry Megatron tensor-parallel specs
+(``parallel/tensor_parallel.py``) and long sequences ride ring attention
+(``parallel/ring_attention.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.attention import LayerNorm, MultiHeadAttention
+
+
+def transformer_block(embed_dim: int, num_heads: int, mlp_dim: int,
+                      dropout: float = 0.0, causal: bool = True,
+                      shard: bool = False) -> nn.Sequential:
+    """Pre-norm block: x + MHA(LN(x)); x + MLP(LN(x)).  With ``shard``,
+    MLP is column→row parallel (one all-reduce per block, Megatron)."""
+    attn = (nn.Sequential()
+            .add(LayerNorm(embed_dim))
+            .add(MultiHeadAttention(embed_dim, num_heads, causal=causal,
+                                    dropout=dropout, shard=shard)))
+    mlp = (nn.Sequential()
+           .add(LayerNorm(embed_dim))
+           .add(nn.Linear(embed_dim, mlp_dim,
+                          shard="column" if shard else None))
+           .add(nn.GELU())
+           .add(nn.Linear(mlp_dim, embed_dim,
+                          shard="row" if shard else None)))
+    return (nn.Sequential()
+            .add(nn.Sequential()
+                 .add(nn.ConcatTable().add(attn).add(nn.Identity()))
+                 .add(nn.CAddTable()))
+            .add(nn.Sequential()
+                 .add(nn.ConcatTable().add(mlp).add(nn.Identity()))
+                 .add(nn.CAddTable())))
+
+
+class LearnedPositionalEmbedding(nn.Module):
+    def __init__(self, max_len: int, embed_dim: int, name=None):
+        super().__init__(name)
+        self.max_len, self.embed_dim = max_len, embed_dim
+
+    def init(self, rng):
+        import jax
+        w = 0.02 * jax.random.normal(rng, (self.max_len, self.embed_dim))
+        return {"weight": w}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        T = input.shape[1]
+        return input + params["weight"][:T].astype(input.dtype), state
+
+
+def transformer_lm(vocab_size: int = 32000, embed_dim: int = 512,
+                   num_heads: int = 8, num_layers: int = 6,
+                   mlp_dim: Optional[int] = None, max_len: int = 2048,
+                   dropout: float = 0.0, shard: bool = False):
+    """Decoder-only LM: tokens (N, T) → log-probs (N, T, V)."""
+    mlp_dim = mlp_dim or 4 * embed_dim
+    m = (nn.Sequential(name="TransformerLM")
+         .add(nn.LookupTable(vocab_size, embed_dim))
+         .add(LearnedPositionalEmbedding(max_len, embed_dim)))
+    for _ in range(num_layers):
+        m.add(transformer_block(embed_dim, num_heads, mlp_dim, dropout,
+                                causal=True, shard=shard))
+    m.add(LayerNorm(embed_dim))
+    m.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))
+    m.add(nn.LogSoftMax())
+    return m

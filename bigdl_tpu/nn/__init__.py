@@ -47,3 +47,6 @@ from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole, MultiRNNCell,
     Recurrent, BiRecurrent, RecurrentDecoder, TimeDistributed,
 )
+from bigdl_tpu.nn.attention import (
+    LayerNorm, MultiHeadAttention, dot_product_attention,
+)

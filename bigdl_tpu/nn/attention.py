@@ -1,0 +1,147 @@
+"""Attention layers.
+
+No reference analog — BigDL v0.x predates transformers (SURVEY §5:
+"no attention, no ring/Ulysses/blockwise anything") — but long-context and
+distributed are first-class in the TPU build, so attention is core nn
+surface.  Sequence-parallel execution lives in
+``bigdl_tpu.parallel.ring_attention``; this module is the single-device
+math it distributes.
+
+Layout: (N, T, D) batch-major, heads split internally to (N, H, T, Dh).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.initialization import Xavier
+
+
+class LayerNorm(Module):
+    """Layer normalization over the last dim (standard transformer norm;
+    the reference's closest is ``Normalize``)."""
+
+    def __init__(self, normalized_size: int, eps: float = 1e-5,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size = normalized_size
+        self.eps = eps
+
+    def init(self, rng):
+        return {"weight": jnp.ones((self.size,), jnp.float32),
+                "bias": jnp.zeros((self.size,), jnp.float32)}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        # normalize in f32 for bf16 stability, cast back
+        x = input.astype(jnp.float32)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["weight"] + params["bias"]
+        return y.astype(input.dtype), state
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False,
+                          mask: Optional[jnp.ndarray] = None,
+                          scale: Optional[float] = None):
+    """Softmax attention. q,k,v: (N, H, Tq, Dh)/(N, H, Tk, Dh).
+    Softmax statistics in f32 (bf16-safe)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("nhqd,nhkd->nhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Tq, Tk = scores.shape[-2], scores.shape[-1]
+        # offset supports Tq != Tk (decode: query tail of the sequence)
+        qi = jnp.arange(Tq)[:, None] + (Tk - Tq)
+        ki = jnp.arange(Tk)[None, :]
+        scores = jnp.where(ki <= qi, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("nhqk,nhkd->nhqd", w, v)
+
+
+class MultiHeadAttention(Module):
+    """Multi-head self/cross attention with fused qkv projection.
+
+    Input: tensor (N, T, D) for self-attention, or a (query, kv) tuple for
+    cross-attention."""
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 causal: bool = False, with_bias: bool = True,
+                 dropout: float = 0.0, shard: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.causal = causal
+        self.with_bias = with_bias
+        self.dropout = dropout
+        # tensor parallelism (Megatron attention split): heads sharded over
+        # the `model` mesh axis via qkv column / output row parallel specs
+        self.shard = shard
+
+    def param_specs(self):
+        """Weights here are stored (in, out) and used as x @ W, so the
+        output-dim split is dim 1 (vs dim 0 for Linear's (out, in))."""
+        if not self.shard:
+            return None
+        from jax.sharding import PartitionSpec as P
+        sp = {"wq": P(None, "model"), "wk": P(None, "model"),
+              "wv": P(None, "model"), "wo": P("model", None)}
+        if self.with_bias:
+            sp.update({"bq": P("model"), "bk": P("model"),
+                       "bv": P("model"), "bo": P()})
+        return sp
+
+    def init(self, rng):
+        D = self.embed_dim
+        ks = jax.random.split(rng, 4)
+        xav = Xavier()
+        params = {
+            "wq": xav.init(ks[0], (D, D), D, D),
+            "wk": xav.init(ks[1], (D, D), D, D),
+            "wv": xav.init(ks[2], (D, D), D, D),
+            "wo": xav.init(ks[3], (D, D), D, D),
+        }
+        if self.with_bias:
+            for n in ("bq", "bk", "bv", "bo"):
+                params[n] = jnp.zeros((D,), jnp.float32)
+        return params, {}
+
+    def _split(self, x):
+        N, T, _ = x.shape
+        return x.reshape(N, T, self.num_heads, self.head_dim) \
+                .transpose(0, 2, 1, 3)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if isinstance(input, (tuple, list)):
+            xq, xkv = input
+        else:
+            xq = xkv = input
+        q = xq @ params["wq"]
+        k = xkv @ params["wk"]
+        v = xkv @ params["wv"]
+        if self.with_bias:
+            q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
+        q, k, v = self._split(q), self._split(k), self._split(v)
+        o = dot_product_attention(q, k, v, causal=self.causal)
+        if self.dropout > 0 and training:
+            if rng is None:
+                raise ValueError("attention dropout needs an rng")
+            keep = 1.0 - self.dropout
+            o = jnp.where(jax.random.bernoulli(rng, keep, o.shape),
+                          o / keep, 0.0)
+        N, H, T, Dh = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(N, T, H * Dh)
+        out = o @ params["wo"]
+        if self.with_bias:
+            out = out + params["bo"]
+        return out, state

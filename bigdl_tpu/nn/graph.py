@@ -105,6 +105,12 @@ class Graph(Module):
             visit(out)
         return order
 
+    def spec_children(self):
+        out = {}
+        for i, node in enumerate(self._order):
+            out.setdefault(self._param_keys[i], node.module)
+        return out
+
     def init(self, rng):
         params, state = {}, {}
         for i, node in enumerate(self._order):

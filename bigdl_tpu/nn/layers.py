@@ -32,6 +32,7 @@ class Linear(Module):
                  with_bias: bool = True,
                  weight_init: Optional[InitializationMethod] = None,
                  bias_init: Optional[InitializationMethod] = None,
+                 shard: Optional[str] = None,
                  name: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_size
@@ -39,6 +40,20 @@ class Linear(Module):
         self.with_bias = with_bias
         self.weight_init = weight_init or RandomUniform()
         self.bias_init = bias_init or RandomUniform()
+        # tensor parallelism: "column" (split output dim) / "row" (split
+        # input dim) / None — see parallel/tensor_parallel.py
+        self.shard = shard
+
+    def param_specs(self):
+        if self.shard is None:
+            return None
+        from bigdl_tpu.parallel.tensor_parallel import (
+            column_parallel_linear_specs, row_parallel_linear_specs)
+        if self.shard == "column":
+            return column_parallel_linear_specs(self.with_bias)
+        if self.shard == "row":
+            return row_parallel_linear_specs(self.with_bias)
+        raise ValueError(f"unknown shard mode {self.shard!r}")
 
     def init(self, rng):
         k_w, k_b = jax.random.split(rng)

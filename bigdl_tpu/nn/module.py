@@ -148,6 +148,20 @@ class Module:
         self._params = params
         return self
 
+    # ---------------------------------------------------- spec traversal
+    def spec_children(self):
+        """How sharding-spec builders traverse this module
+        (``parallel.tensor_parallel.build_param_specs``):
+
+        - ``None`` (default): leaf — params replicated unless the module
+          overrides ``param_specs()``;
+        - a single ``Module``: this wrapper delegates ``init`` to that
+          child (params structures identical);
+        - a dict ``{param_key: Module}``: params nest children under
+          those keys.
+        """
+        return None
+
     # -------------------------------------------------------------- misc
     def set_name(self, name: str) -> "Module":
         self.name = name
@@ -174,6 +188,9 @@ class Container(Module):
     def add(self, module: Module) -> "Container":
         self.modules.append(module)
         return self
+
+    def spec_children(self):
+        return {str(i): m for i, m in enumerate(self.modules)}
 
     def __len__(self):
         return len(self.modules)

@@ -239,6 +239,9 @@ class MultiRNNCell(Cell):
         self.cells = list(cells)
         self.hidden_size = self.cells[-1].hidden_size
 
+    def spec_children(self):
+        return {str(i): c for i, c in enumerate(self.cells)}
+
     def init(self, rng):
         params = {}
         for i, c in enumerate(self.cells):
@@ -268,6 +271,9 @@ class Recurrent(Module):
         super().__init__(name)
         self.cell = cell
         self.reverse = reverse
+
+    def spec_children(self):
+        return self.cell
 
     def init(self, rng):
         return self.cell.init(rng)
@@ -302,6 +308,9 @@ class BiRecurrent(Module):
                              else copy.deepcopy(cell_fwd), reverse=True)
         self.merge = merge
 
+    def spec_children(self):
+        return {"fwd": self.fwd, "bwd": self.bwd}
+
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
         pf, _ = self.fwd.init(k1)
@@ -326,6 +335,9 @@ class RecurrentDecoder(Module):
         super().__init__(name)
         self.cell = cell
         self.seq_length = seq_length
+
+    def spec_children(self):
+        return self.cell
 
     def init(self, rng):
         return self.cell.init(rng)
@@ -352,6 +364,9 @@ class TimeDistributed(Module):
     def __init__(self, layer: Module, name: Optional[str] = None):
         super().__init__(name)
         self.layer = layer
+
+    def spec_children(self):
+        return self.layer
 
     def init(self, rng):
         return self.layer.init(rng)

@@ -392,6 +392,9 @@ class Scale(Module):
         self.cmul = CMul(size)
         self.cadd = CAdd(size)
 
+    def spec_children(self):
+        return {"mul": self.cmul, "add": self.cadd}
+
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
         p1, _ = self.cmul.init(k1)

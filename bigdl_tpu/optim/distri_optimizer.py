@@ -66,24 +66,45 @@ class DistriOptimizer(Optimizer):
 
     def __init__(self, model, dataset, criterion, batch_size=None,
                  mesh: Optional[Mesh] = None,
-                 parameter_sharding: bool = True):
+                 parameter_sharding: bool = True,
+                 param_specs=None):
+        """``param_specs``: optional pytree of PartitionSpec matching the
+        model params — enables tensor parallelism (build with
+        ``parallel.tensor_parallel.build_param_specs``).  ``None`` keeps
+        params replicated (pure DP)."""
         super().__init__(model, dataset, criterion, batch_size)
         self.mesh = mesh or Engine.get_mesh()
         self.parameter_sharding = parameter_sharding
+        self.param_specs = param_specs
         self.failure_retry_times = Engine._state.failure_retry_times
 
     # -------------------------------------------------------- shardings
     def _shardings(self, params, ostate):
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
-        data = NamedSharding(mesh, P("data"))
-        param_sh = tmap(lambda _: repl, params)
-        if self.parameter_sharding:
+        param_sh = tmap(lambda _: repl, params) if self.param_specs is None \
+            else tmap(lambda sp: NamedSharding(mesh, sp), self.param_specs,
+                      is_leaf=lambda x: isinstance(x, P))
+        if self.parameter_sharding and self.param_specs is None:
+            # ZeRO-1: shard optimizer state over the data axis (only when
+            # params are replicated — TP already shards the state with them)
             ostate_sh = tmap(
                 lambda l: NamedSharding(mesh, batch_axis_spec(l, mesh)),
                 ostate)
+        elif self.param_specs is not None:
+            # optimizer-state subtrees (velocity/m/v/...) are tmaps over the
+            # params, so a subtree with the params' structure inherits the
+            # param shardings leaf-for-leaf; anything else is replicated
+            pstruct = jax.tree_util.tree_structure(params)
+            ostate_sh = {}
+            for key, sub in ostate.items():
+                if jax.tree_util.tree_structure(sub) == pstruct:
+                    ostate_sh[key] = param_sh
+                else:
+                    ostate_sh[key] = tmap(lambda _: repl, sub)
         else:
             ostate_sh = tmap(lambda _: repl, ostate)
+        data = NamedSharding(mesh, P("data"))
         return repl, data, param_sh, ostate_sh
 
     def _make_global(self, arr: np.ndarray, sharding: NamedSharding):

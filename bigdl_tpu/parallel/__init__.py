@@ -1,1 +1,17 @@
-"""(populated in subsequent milestones)"""
+"""bigdl_tpu.parallel — mesh topology, tensor parallelism, sequence
+parallelism (ring attention).
+
+Replaces the reference's distributed substrate (Spark BlockManager
+AllReduce, ``DL/parameters/``) with sharding-annotation-driven XLA
+collectives over ICI, and adds the TP/SP strategies the reference lacks
+(SURVEY §2.9).
+"""
+
+from bigdl_tpu.parallel.mesh import (
+    create_mesh, data_sharding, replicated, mesh_shape,
+)
+from bigdl_tpu.parallel.ring_attention import ring_attention
+from bigdl_tpu.parallel.tensor_parallel import (
+    build_param_specs, column_parallel_linear_specs,
+    row_parallel_linear_specs,
+)

@@ -1,0 +1,51 @@
+"""Mesh utilities — the topology layer of the distributed design.
+
+Reference analog: the Spark driver/executor topology (``Engine.scala`` node
+and core counts, ``SparkExtension``/BlockManager placement).  On TPU the
+topology is a named ``jax.sharding.Mesh``; everything else (which collective
+runs where) falls out of sharding annotations.
+
+Axis conventions used across the framework:
+- ``data``  — data parallelism (batch dim; gradients all-reduce over it)
+- ``model`` — tensor/model parallelism (Megatron-style column/row splits)
+- ``seq``   — sequence/context parallelism (ring attention)
+- ``pipe``  — pipeline stages
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(data: int = -1, model: int = 1, seq: int = 1,
+                pipe: int = 1, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named mesh over the devices.  ``data=-1`` absorbs whatever
+    is left after the explicit axes."""
+    devs = list(jax.devices()) if devices is None else list(devices)
+    n = len(devs)
+    fixed = model * seq * pipe
+    if data == -1:
+        assert n % fixed == 0, f"{n} devices not divisible by {fixed}"
+        data = n // fixed
+    total = data * fixed
+    assert total <= n, f"mesh needs {total} devices, have {n}"
+    arr = np.array(devs[:total]).reshape(data, model, seq, pipe)
+    return Mesh(arr, axis_names=("data", "model", "seq", "pipe"))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim sharded over every data-ish axis (batch rides data;
+    seq-parallel attention additionally shards dim 1)."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mesh_shape(mesh: Mesh) -> dict:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

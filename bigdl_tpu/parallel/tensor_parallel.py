@@ -1,0 +1,67 @@
+"""Tensor (model) parallelism via sharding annotations.
+
+No reference analog (SURVEY §2.9: TP absent in BigDL) — first-class here.
+Design: Megatron-style column/row parameter splits expressed as
+``PartitionSpec``s over the ``model`` mesh axis; **GSPMD inserts the
+collectives** (all-gather/reduce-scatter around the split matmuls) — no
+hand-written communication, the scaling-book recipe.
+
+Modules advertise their own sharding via ``param_specs()`` (mirroring the
+pytree their ``init`` returns); ``build_param_specs`` walks a model and
+fills ``P()`` (replicated) for everything that doesn't opt in.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from bigdl_tpu.nn.module import Container, Module
+
+tmap = jax.tree_util.tree_map
+
+
+def build_param_specs(module: Module, params):
+    """Pytree of PartitionSpec matching ``params``.  Traversal follows
+    ``Module.spec_children()`` (single-child delegation for wrappers like
+    TimeDistributed/Recurrent, keyed dicts for containers), so shard
+    annotations survive arbitrary nesting."""
+    own = getattr(module, "param_specs", None)
+    if own is not None:
+        sp = own()
+        if sp is not None:
+            return sp
+    children = module.spec_children()
+    if children is None:
+        return tmap(lambda _: P(), params)
+    if isinstance(children, Module):
+        return build_param_specs(children, params)
+    return {k: build_param_specs(children[k], v) if k in children
+            else tmap(lambda _: P(), v)
+            for k, v in params.items()}
+
+
+def column_parallel_linear_specs(with_bias: bool = True,
+                                 axis: str = "model"):
+    """Split the OUTPUT features: weight (out, in) → P(axis, None).
+    Activations come out sharded on the feature dim."""
+    sp = {"weight": P(axis, None)}
+    if with_bias:
+        sp["bias"] = P(axis)
+    return sp
+
+
+def row_parallel_linear_specs(with_bias: bool = True, axis: str = "model"):
+    """Split the INPUT features: weight (out, in) → P(None, axis); the
+    matmul produces partial sums that GSPMD all-reduces.  Bias replicated."""
+    sp = {"weight": P(None, axis)}
+    if with_bias:
+        sp["bias"] = P()
+    return sp
+
+
+# The concrete opt-ins live on the modules themselves: Linear(shard=
+# "column"/"row") and MultiHeadAttention(shard=True) implement
+# ``param_specs()`` using the helpers above (see layers.py / attention.py).

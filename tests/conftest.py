@@ -28,3 +28,12 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def _reset_engine_mesh():
+    """Isolate tests from any globally-set Engine mesh."""
+    from bigdl_tpu.engine import Engine
+    prev = Engine._state.mesh
+    yield
+    Engine._state.mesh = prev
