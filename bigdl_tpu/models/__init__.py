@@ -9,3 +9,4 @@ from bigdl_tpu.models.autoencoder import autoencoder
 from bigdl_tpu.models.transformer import (
     transformer_lm, transformer_block, LearnedPositionalEmbedding,
 )
+from bigdl_tpu.models.recommender import NeuralCF, WideAndDeep

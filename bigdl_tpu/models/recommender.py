@@ -1,0 +1,134 @@
+"""Recommender models: NCF and Wide&Deep.
+
+Reference: the Wide&Deep / NCF workloads named in BASELINE.json ("Sparse
+embedding allreduce"); BigDL ships these via its Zoo examples on
+``SparseLinear``/``LookupTableSparse`` (SURVEY §2.1 sparse backend:
+"recommender workloads").
+
+Inputs are pytrees (BigDL ``Table``):
+- NCF: (user_ids (N,), item_ids (N,))
+- Wide&Deep: ((wide_ids, wide_weights), deep_categorical_ids, dense)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.sparse import LookupTableSparse, SparseLinear
+
+
+class NeuralCF(Module):
+    """Neural Collaborative Filtering (He et al.): GMF branch ⊙ of user/item
+    embeddings + MLP branch on concatenated embeddings, fused head.
+    Output: sigmoid score (N, 1)."""
+
+    def __init__(self, user_count: int, item_count: int,
+                 embed_dim: int = 16, mlp_dims: Sequence[int] = (64, 32, 16),
+                 name: Optional[str] = None):
+        super().__init__(name or "NeuralCF")
+        self.user_count, self.item_count = user_count, item_count
+        self.embed_dim = embed_dim
+        self.user_gmf = nn.LookupTable(user_count, embed_dim)
+        self.item_gmf = nn.LookupTable(item_count, embed_dim)
+        self.user_mlp = nn.LookupTable(user_count, embed_dim)
+        self.item_mlp = nn.LookupTable(item_count, embed_dim)
+        mlp = nn.Sequential()
+        prev = 2 * embed_dim
+        for d in mlp_dims:
+            mlp.add(nn.Linear(prev, d)).add(nn.ReLU())
+            prev = d
+        self.mlp = mlp
+        self.head = nn.Linear(embed_dim + prev, 1)
+
+    def spec_children(self):
+        return {"user_gmf": self.user_gmf, "item_gmf": self.item_gmf,
+                "user_mlp": self.user_mlp, "item_mlp": self.item_mlp,
+                "mlp": self.mlp, "head": self.head}
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 6)
+        names = ["user_gmf", "item_gmf", "user_mlp", "item_mlp", "mlp",
+                 "head"]
+        params, state = {}, {}
+        for n, k in zip(names, ks):
+            p, s = getattr(self, n).init(k)
+            params[n], state[n] = p, s
+        return params, state
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        users, items = input
+        ug, _ = self.user_gmf.apply(params["user_gmf"], {}, users)
+        ig, _ = self.item_gmf.apply(params["item_gmf"], {}, items)
+        um, _ = self.user_mlp.apply(params["user_mlp"], {}, users)
+        im, _ = self.item_mlp.apply(params["item_mlp"], {}, items)
+        gmf = ug * ig
+        mlp_in = jnp.concatenate([um, im], axis=-1)
+        mlp_out, _ = self.mlp.apply(params["mlp"], state["mlp"], mlp_in,
+                                    training=training, rng=rng)
+        fused = jnp.concatenate([gmf, mlp_out], axis=-1)
+        score, _ = self.head.apply(params["head"], {}, fused)
+        return jax.nn.sigmoid(score), state
+
+
+class WideAndDeep(Module):
+    """Wide&Deep (Cheng et al.): wide = SparseLinear over cross-feature id
+    bags; deep = embedding bags + dense features through an MLP; summed
+    logits → sigmoid.
+
+    Input: ((wide_ids, wide_weights), deep_ids, dense) where deep_ids is
+    (N, n_deep_fields) int and dense (N, dense_dim) float."""
+
+    def __init__(self, wide_dim: int, deep_field_counts: Sequence[int],
+                 dense_dim: int = 0, embed_dim: int = 16,
+                 hidden: Sequence[int] = (100, 50),
+                 name: Optional[str] = None):
+        super().__init__(name or "WideAndDeep")
+        self.wide = SparseLinear(wide_dim, 1)
+        self.deep_field_counts = list(deep_field_counts)
+        self.embeds = [nn.LookupTable(c, embed_dim)
+                       for c in self.deep_field_counts]
+        deep_in = embed_dim * len(self.deep_field_counts) + dense_dim
+        deep = nn.Sequential()
+        prev = deep_in
+        for h in hidden:
+            deep.add(nn.Linear(prev, h)).add(nn.ReLU())
+            prev = h
+        deep.add(nn.Linear(prev, 1))
+        self.deep = deep
+        self.dense_dim = dense_dim
+
+    def spec_children(self):
+        out = {"wide": self.wide, "deep": self.deep}
+        for i, e in enumerate(self.embeds):
+            out[f"embed{i}"] = e
+        return out
+
+    def init(self, rng):
+        params, state = {}, {}
+        rng, k = jax.random.split(rng)
+        params["wide"], state["wide"] = self.wide.init(k)
+        for i, e in enumerate(self.embeds):
+            rng, k = jax.random.split(rng)
+            params[f"embed{i}"], state[f"embed{i}"] = e.init(k)
+        rng, k = jax.random.split(rng)
+        params["deep"], state["deep"] = self.deep.init(k)
+        return params, state
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        wide_in, deep_ids, dense = input
+        wide_logit, _ = self.wide.apply(params["wide"], {}, wide_in)
+        parts = []
+        for i, e in enumerate(self.embeds):
+            emb, _ = e.apply(params[f"embed{i}"], {}, deep_ids[:, i])
+            parts.append(emb)
+        if self.dense_dim:
+            parts.append(dense)
+        deep_logit, _ = self.deep.apply(params["deep"], state["deep"],
+                                        jnp.concatenate(parts, axis=-1),
+                                        training=training, rng=rng)
+        return jax.nn.sigmoid(wide_logit + deep_logit), state

@@ -50,3 +50,6 @@ from bigdl_tpu.nn.recurrent import (
 from bigdl_tpu.nn.attention import (
     LayerNorm, MultiHeadAttention, dot_product_attention,
 )
+from bigdl_tpu.nn.sparse import (
+    LookupTableSparse, SparseLinear, SparseJoinTable, dense_to_bags,
+)

@@ -119,8 +119,10 @@ class BCECriterion(Criterion):
         self.size_average = size_average
 
     def apply(self, input, target):
-        eps = 1e-12
-        x = jnp.clip(input, eps, 1.0 - eps)
+        # eps must be representable at the input dtype: 1 - 1e-12 == 1.0 in
+        # f32, which would let a saturated sigmoid produce log(0) = -inf
+        eps = jnp.finfo(jnp.result_type(input.dtype, jnp.float32)).eps
+        x = jnp.clip(input.astype(jnp.float32), eps, 1.0 - eps)
         l = -(target * jnp.log(x) + (1.0 - target) * jnp.log1p(-x))
         if self.weights is not None:
             l = l * self.weights

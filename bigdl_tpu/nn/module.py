@@ -117,6 +117,23 @@ class Module:
         if self._grads is not None:
             self._grads = jax.tree_util.tree_map(jnp.zeros_like, self._grads)
 
+    # --------------------------------------------------------- inference
+    def predict(self, data, batch_size: int = 128):
+        """Batched inference (reference ``AbstractModule.predict``; see
+        optim/predictor.py)."""
+        from bigdl_tpu.optim.predictor import Predictor
+        return Predictor(self, batch_size=batch_size).predict(data)
+
+    def predict_class(self, data, batch_size: int = 128):
+        from bigdl_tpu.optim.predictor import Predictor
+        return Predictor(self, batch_size=batch_size).predict_class(data)
+
+    def evaluate_on(self, dataset, methods):
+        """Metric evaluation (reference ``AbstractModule.evaluate(...)``
+        entry points, `:845-895`)."""
+        from bigdl_tpu.optim.predictor import Evaluator
+        return Evaluator(self).evaluate(dataset, methods)
+
     # ------------------------------------------------------------- modes
     def evaluate(self) -> "Module":
         """Switch eager mode to inference (reference ``:429-445``)."""

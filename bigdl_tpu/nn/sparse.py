@@ -1,0 +1,137 @@
+"""Sparse layers for recommender workloads.
+
+Reference: ``DL/tensor/SparseTensor.scala`` (COO) + ``nn/SparseLinear``,
+``nn/LookupTableSparse``, ``nn/SparseJoinTable``, ``nn/DenseToSparse`` —
+the Wide&Deep / NCF path named in BASELINE.json.
+
+TPU redesign: COO sparse×dense gemm is the WRONG primitive on TPU (the MXU
+wants dense tiles; scatter/gather beats sparse matmul).  The equivalent
+representation is **fixed-width id bags**: each sample carries up to
+``bag_size`` (id, weight) pairs, padded with id = -1.  A sparse feature
+vector x with nnz entries (i, v) then maps to ids=i, weights=v, and
+``SparseLinear``'s W @ x becomes a weighted embedding-bag sum — one gather
++ segment-sum, which is exactly how TPU recommenders are built.  Fixed
+width keeps shapes static for XLA (ragged bags are bucketed host-side).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.initialization import RandomNormal, RandomUniform
+
+
+def dense_to_bags(dense: np.ndarray, bag_size: Optional[int] = None):
+    """Convert a dense batch (N, D) with few non-zeros into (ids, weights)
+    fixed-width bags (host-side helper; reference ``DenseToSparse``)."""
+    N, D = dense.shape
+    nnz = (dense != 0)
+    width = bag_size or int(nnz.sum(axis=1).max())
+    ids = np.full((N, width), -1, np.int32)
+    weights = np.zeros((N, width), np.float32)
+    for n in range(N):
+        idx = np.nonzero(nnz[n])[0][:width]
+        ids[n, :len(idx)] = idx
+        weights[n, :len(idx)] = dense[n, idx]
+    return ids, weights
+
+
+class LookupTableSparse(Module):
+    """Embedding bag with combiner (reference ``LookupTableSparse.scala``:
+    combiner sum/mean/sqrtn over each sample's ids, optional per-id
+    weights).
+
+    Input: ids (N, B) int with -1 padding, or (ids, weights) tuple.
+    Output: (N, n_output)."""
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 weight_init=None, name: Optional[str] = None):
+        super().__init__(name)
+        assert combiner in ("sum", "mean", "sqrtn")
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+        self.weight_init = weight_init or RandomNormal(0.0, 0.05)
+
+    def init(self, rng):
+        w = self.weight_init.init(rng, (self.n_index, self.n_output),
+                                  self.n_index, self.n_output)
+        return {"weight": w}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if isinstance(input, (tuple, list)):
+            ids, weights = input
+        else:
+            ids, weights = input, None
+        ids = ids.astype(jnp.int32)
+        mask = (ids >= 0)
+        safe = jnp.where(mask, ids, 0)
+        emb = jnp.take(params["weight"], safe, axis=0)  # (N, B, O)
+        w = mask.astype(emb.dtype)
+        if weights is not None:
+            w = w * weights.astype(emb.dtype)
+        summed = jnp.einsum("nbo,nb->no", emb, w)
+        if self.combiner == "sum":
+            return summed, state
+        denom = jnp.sum(jnp.abs(w), axis=1, keepdims=True)
+        if self.combiner == "sqrtn":
+            denom = jnp.sqrt(jnp.sum(w * w, axis=1, keepdims=True))
+        return summed / jnp.maximum(denom, 1e-12), state
+
+
+class SparseLinear(Module):
+    """Affine layer on sparse inputs (reference ``SparseLinear.scala``:
+    sparse×dense addmm).  Input: (ids, values) bags representing sparse
+    rows of width ``input_size``; computed as a weighted embedding-bag over
+    the weight's columns + bias — mathematically identical to W @ x + b."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self._bag = LookupTableSparse(input_size, output_size, "sum",
+                                      weight_init=RandomUniform())
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        p, _ = self._bag.init(k1)
+        params = {"weight": p["weight"]}  # (input_size, output_size) = W.T
+        if self.with_bias:
+            params["bias"] = RandomUniform().init(
+                k2, (self.output_size,), self.input_size, self.output_size)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y, _ = self._bag.apply({"weight": params["weight"]}, {}, input)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y, state
+
+
+class SparseJoinTable(Module):
+    """Concatenate bag-form sparse features (reference
+    ``SparseJoinTable.scala`` concatenates COO tensors along dim 1).
+    Input: sequence of (ids, weights) whose id spaces are offset by each
+    predecessor's ``input_size``; sizes given at construction."""
+
+    def __init__(self, sizes, name: Optional[str] = None):
+        super().__init__(name)
+        self.sizes = list(sizes)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ids_out, w_out = [], []
+        offset = 0
+        for (ids, w), size in zip(input, self.sizes):
+            mask = ids >= 0
+            ids_out.append(jnp.where(mask, ids + offset, -1))
+            w_out.append(w)
+            offset += size
+        return (jnp.concatenate(ids_out, axis=1),
+                jnp.concatenate(w_out, axis=1)), state

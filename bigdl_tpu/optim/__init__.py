@@ -23,3 +23,4 @@ from bigdl_tpu.optim.optimizer import (
     global_norm,
 )
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.predictor import Predictor, Evaluator, PredictionService

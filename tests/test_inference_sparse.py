@@ -1,0 +1,210 @@
+"""Inference path + sparse/recommender tests (reference:
+``PredictorSpec``, ``EvaluatorSpec``, ``SparseLinearSpec``,
+``LookupTableSparseSpec``)."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import models, nn, optim
+from bigdl_tpu.dataset import (
+    DataSet, MiniBatch, Sample, SampleToMiniBatch,
+)
+from bigdl_tpu.nn.sparse import dense_to_bags
+from bigdl_tpu.optim.predictor import Evaluator, PredictionService, Predictor
+
+
+def rng(i=0):
+    return jax.random.PRNGKey(i)
+
+
+def make_model():
+    return (nn.Sequential()
+            .add(nn.Linear(4, 16)).add(nn.ReLU())
+            .add(nn.Linear(16, 3)).add(nn.LogSoftMax())).initialize(0)
+
+
+class TestPredictor:
+    def test_predict_samples(self):
+        model = make_model()
+        samples = [Sample(np.ones((4,), np.float32)) for _ in range(10)]
+        out = model.predict(samples, batch_size=4)
+        assert out.shape == (10, 3)
+
+    def test_predict_class(self):
+        model = make_model()
+        samples = [Sample(np.ones((4,), np.float32)) for _ in range(5)]
+        cls = model.predict_class(samples)
+        assert cls.shape == (5,)
+        assert set(np.unique(cls)) <= {0, 1, 2}
+
+    def test_predict_dataset(self):
+        model = make_model()
+        samples = [Sample(np.full((4,), i, np.float32)) for i in range(8)]
+        ds = DataSet.array(samples) >> SampleToMiniBatch(
+            4, drop_remainder=False)
+        out = Predictor(model).predict(ds)
+        assert out.shape == (8, 3)
+
+    def test_predict_consistent_across_batch_sizes(self):
+        model = make_model()
+        samples = [Sample(np.random.default_rng(i).normal(
+            0, 1, (4,)).astype(np.float32)) for i in range(7)]
+        a = Predictor(model, batch_size=3).predict(samples)
+        b = Predictor(model, batch_size=7).predict(samples)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+class TestEvaluator:
+    def test_evaluate_metrics(self):
+        model = make_model()
+        xs = np.random.default_rng(0).normal(0, 1, (32, 4)).astype(np.float32)
+        preds = model.predict([Sample(x) for x in xs]).argmax(-1)
+        samples = [Sample(x, np.int32(p)) for x, p in zip(xs, preds)]
+        ds = DataSet.array(samples) >> SampleToMiniBatch(8)
+        res = model.evaluate_on(ds, [optim.Top1Accuracy(), optim.Loss()])
+        assert res["Top1Accuracy"].result == 1.0  # labels = own predictions
+        assert np.isfinite(res["Loss"].result)
+
+
+class TestPredictionService:
+    def test_odd_sizes_and_chunking(self):
+        model = make_model()
+        svc = PredictionService(model, batch_size=4)
+        out1 = svc.predict(np.ones((1, 4), np.float32))
+        out9 = svc.predict(np.ones((9, 4), np.float32))
+        assert out1.shape == (1, 3) and out9.shape == (9, 3)
+        np.testing.assert_allclose(out9[0], out1[0], rtol=1e-6)
+
+    def test_concurrent_callers(self):
+        model = make_model()
+        svc = PredictionService(model, batch_size=8)
+        errs = []
+
+        def worker(seed):
+            try:
+                x = np.random.default_rng(seed).normal(
+                    0, 1, (5, 4)).astype(np.float32)
+                for _ in range(5):
+                    out = svc.predict(x)
+                    assert out.shape == (5, 3)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        assert svc.request_count == 20
+
+
+class TestSparse:
+    def test_dense_to_bags_roundtrip(self):
+        dense = np.zeros((2, 10), np.float32)
+        dense[0, [1, 5]] = [2.0, 3.0]
+        dense[1, 9] = 1.5
+        ids, w = dense_to_bags(dense)
+        assert ids.shape == w.shape == (2, 2)
+        assert set(ids[0]) == {1, 5}
+        assert ids[1, 1] == -1 and w[1, 1] == 0.0
+
+    def test_sparse_linear_matches_dense(self):
+        lin = nn.SparseLinear(10, 3).initialize(0)
+        dense = np.zeros((4, 10), np.float32)
+        r = np.random.default_rng(0)
+        for i in range(4):
+            cols = r.choice(10, 3, replace=False)
+            dense[i, cols] = r.normal(0, 1, 3)
+        ids, w = dense_to_bags(dense)
+        y_sparse = lin.forward((jnp.asarray(ids), jnp.asarray(w)))
+        W = lin._params["weight"]  # (in, out)
+        expected = dense @ np.asarray(W) + np.asarray(lin._params["bias"])
+        np.testing.assert_allclose(np.asarray(y_sparse), expected, rtol=1e-5,
+                                   atol=1e-6)
+
+    # tf.nn.embedding_lookup_sparse semantics (BigDL LookupTableSparse
+    # mirrors them): mean = sum(w*e)/sum(|w|), sqrtn = sum(w*e)/sqrt(sum w²)
+    @pytest.mark.parametrize("combiner,expected", [
+        ("sum", 3.0), ("mean", 1.0), ("sqrtn", 3.0 / np.sqrt(5))])
+    def test_lookup_table_sparse_combiners(self, combiner, expected):
+        lt = nn.LookupTableSparse(5, 1, combiner=combiner)
+        lt._params = {"weight": jnp.ones((5, 1))}
+        lt._state = {}
+        ids = jnp.array([[0, 1, -1]])
+        w = jnp.array([[1.0, 2.0, 0.0]])
+        y = lt.forward((ids, w))
+        np.testing.assert_allclose(float(y[0, 0]), expected, rtol=1e-5)
+
+    def test_sparse_join_table(self):
+        j = nn.SparseJoinTable([10, 20])
+        ids = j.forward(((jnp.array([[1, -1]]), jnp.array([[1.0, 0.0]])),
+                         (jnp.array([[3, 5]]), jnp.array([[2.0, 1.0]]))))
+        np.testing.assert_array_equal(np.asarray(ids[0]),
+                                      [[1, -1, 13, 15]])
+
+
+class TestRecommenderModels:
+    def test_ncf_learns_preferences(self):
+        """NCF fits a small synthetic preference matrix."""
+        U, I = 20, 15
+        r = np.random.default_rng(0)
+        u_emb = r.normal(0, 1, (U, 4))
+        i_emb = r.normal(0, 1, (I, 4))
+        labels = ((u_emb @ i_emb.T) > 0).astype(np.float32)
+
+        model = models.NeuralCF(U, I, embed_dim=8, mlp_dims=(16, 8))
+        p, s = model.init(rng(0))
+        users, items = np.meshgrid(np.arange(U), np.arange(I),
+                                   indexing="ij")
+        users = jnp.asarray(users.ravel())
+        items = jnp.asarray(items.ravel())
+        y = jnp.asarray(labels.ravel())[:, None]
+        crit = nn.BCECriterion()
+        method = optim.Adam(learning_rate=0.02)
+        ostate = method.init_state(p)
+
+        @jax.jit
+        def step(p, ostate, it):
+            def loss(p):
+                out, _ = model.apply(p, s, (users, items), training=True)
+                return crit.apply(out, y)
+            l, g = jax.value_and_grad(loss)(p)
+            p, ostate = method.update(g, p, ostate, method.learning_rate, it)
+            return p, ostate, l
+
+        for it in range(200):
+            p, ostate, l = step(p, ostate, it)
+        out, _ = model.apply(p, s, (users, items))
+        acc = float(jnp.mean((out[:, 0] > 0.5) == (y[:, 0] > 0.5)))
+        assert acc > 0.9, acc
+
+    def test_wide_and_deep_forward_and_grad(self):
+        model = models.WideAndDeep(wide_dim=100,
+                                   deep_field_counts=[10, 20],
+                                   dense_dim=3, embed_dim=4)
+        p, s = model.init(rng(0))
+        N = 8
+        wide_ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, 100, (N, 5)))
+        wide_w = jnp.ones((N, 5))
+        deep_ids = jnp.asarray(
+            np.random.default_rng(1).integers(0, 10, (N, 2)))
+        dense = jnp.ones((N, 3))
+        out, _ = model.apply(p, s, ((wide_ids, wide_w), deep_ids, dense))
+        assert out.shape == (N, 1)
+        assert bool(jnp.all((out >= 0) & (out <= 1)))
+
+        def loss(p):
+            o, _ = model.apply(p, s, ((wide_ids, wide_w), deep_ids, dense))
+            return jnp.mean((o - 1.0) ** 2)
+
+        g = jax.grad(loss)(p)
+        total = sum(float(jnp.sum(jnp.abs(l)))
+                    for l in jax.tree_util.tree_leaves(g))
+        assert np.isfinite(total) and total > 0
