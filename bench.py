@@ -6,40 +6,57 @@ records/sec).  Measures the flagship ResNet-50 ImageNet training step
 (fwd+bwd+SGD-momentum update) on the local TPU chip: images/sec/chip —
 the BASELINE.json metric.
 
-The reference repo publishes no absolute images/sec numbers (BASELINE.md);
-``vs_baseline`` is the ratio against the first TPU measurement recorded
-here so later rounds are comparable.
+Config: NHWC, bf16 compute / f32 master params, batch 128, donated
+buffers — the best of the layout×batch sweep on v5e (see git history).
+
+Anchors:
+- ``vs_baseline`` stays ratioed against the round-1 recorded measurement
+  (1945.9 img/s) so rounds are comparable.
+- ``mfu`` is images/sec × 3×4.1 GFLOP/img ÷ 197 TFLOP/s (v5e bf16 peak).
+  NOTE ResNet-50 training on v5e is HBM-bandwidth-bound, not MXU-bound:
+  XLA's cost analysis reports ~79 GB accessed/step at batch 256, i.e. a
+  ~96 ms bandwidth floor at 819 GB/s — the measured step time tracks that
+  floor at ~90%+, so MFU plateaus near 0.16 by roofline, not by waste.
+
+``--scaling`` mode: runs the DistriOptimizer SPMD step on 1..N virtual CPU
+devices and reports parallel efficiency (reference scaling-claim analog,
+``docs/docs/whitepaper.md:160-164``).  Run separately; the default mode is
+what the driver records.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
-# first recorded TPU v5 lite measurement (bf16 compute, batch 64); later
+# round-1 recorded TPU v5 lite measurement (bf16, NCHW, batch 64); later
 # rounds report improvement vs this anchor
-BASELINE_IMAGES_PER_SEC = 1945.9  # 2026-07-29, f32 was ~1000
+BASELINE_IMAGES_PER_SEC = 1945.9  # 2026-07-29 r01
+PEAK_BF16_FLOPS = 197e12          # v5e MXU peak
+TRAIN_GFLOP_PER_IMAGE = 3 * 4.1   # fwd + dgrad + wgrad, ResNet-50/224
 
 
 def main():
     import jax
     import jax.numpy as jnp
+    from functools import partial
     from bigdl_tpu import nn, optim
     from bigdl_tpu.models.resnet import resnet50
-
     from bigdl_tpu.utils.precision import mixed_precision_loss_fn
 
-    model = resnet50()
+    fmt, batch = "NHWC", 128
+    model = resnet50(format=fmt)
     criterion = nn.ClassNLLCriterion()
     method = optim.SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
 
-    batch = 64
     params, mstate = model.init(jax.random.PRNGKey(0))
     ostate = method.init_state(params)
+    shape = (batch, 224, 224, 3) if fmt == "NHWC" else (batch, 3, 224, 224)
     x = jnp.asarray(np.random.default_rng(0).normal(
-        0, 1, (batch, 3, 224, 224)).astype(np.float32))
+        0, 1, shape).astype(np.float32))
     y = jnp.asarray(np.random.default_rng(1).integers(
         0, 1000, (batch,)).astype(np.int32))
 
@@ -52,7 +69,7 @@ def main():
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(p, ms, os_, x, y, lr, it):
         (loss, ms), g = grad_fn(p, ms, x, y)
         p, os_ = method.update(g, p, os_, lr, it)
@@ -64,7 +81,7 @@ def main():
     params, mstate, ostate, loss = step(params, mstate, ostate, x, y, 0.1, 0)
     float(loss)
 
-    iters = 20
+    iters = 32
     t0 = time.perf_counter()
     for i in range(iters):
         params, mstate, ostate, loss = step(params, mstate, ostate, x, y,
@@ -72,16 +89,117 @@ def main():
     float(loss)  # full pipeline sync
     dt = time.perf_counter() - t0
     ips = batch * iters / dt
+    mfu = ips * TRAIN_GFLOP_PER_IMAGE * 1e9 / PEAK_BF16_FLOPS
 
-    vs = 1.0 if BASELINE_IMAGES_PER_SEC is None \
-        else ips / BASELINE_IMAGES_PER_SEC
+    vs = ips / BASELINE_IMAGES_PER_SEC
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": round(vs, 3),
+        "mfu": round(mfu, 4),
+        "config": f"{fmt}/bf16/batch{batch}/donated",
     }))
 
 
+def scaling():
+    """Sharding-overhead harness on a virtual CPU mesh.
+
+    True multi-chip weak scaling cannot be measured on one host: the 8
+    virtual devices share the same physical cores, so contention would
+    masquerade as scaling loss.  What CAN be isolated is the overhead the
+    SPMD partitioning itself adds: run the SAME global problem (fixed
+    global batch) unsharded on 1 device vs sharded over 8 — identical
+    total CPU work, so efficiency = t(1-dev)/t(8-dev) ≈ 1 - collective/
+    partition overhead.  The real 1→32-chip ICI measurement (BASELINE
+    north star >60%) needs pod hardware the driver doesn't provide."""
+    import os
+    import subprocess
+
+    results = {}
+    for n in (1, 8):
+        env = dict(os.environ)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith(
+                     "--xla_force_host_platform_device_count")]
+        flags.append("--xla_force_host_platform_device_count=8")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["_BENCH_SCALING_N"] = str(n)
+        out = subprocess.run(
+            [sys.executable, __file__, "--scaling-child"], env=env,
+            capture_output=True, text=True)
+        if out.returncode != 0:
+            print(out.stderr, file=sys.stderr)
+            raise RuntimeError(f"scaling child n={n} failed")
+        results[n] = float(out.stdout.strip().splitlines()[-1])
+    eff = round(results[8] / results[1], 3)
+    print(json.dumps({
+        "metric": "resnet_cifar_sharding_overhead_efficiency_cpu_mesh",
+        "value": eff,
+        "unit": "parallel_efficiency",
+        "images_per_sec": {str(n): round(results[n], 1) for n in results},
+    }))
+
+
+def scaling_child():
+    import os
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from functools import partial
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.models.resnet import resnet_cifar
+
+    n = int(os.environ["_BENCH_SCALING_N"])
+    devs = jax.devices()
+    assert len(devs) >= n, (n, devs)
+    mesh = Mesh(np.array(devs[:n]), ("data",))
+
+    model = resnet_cifar(depth=20)
+    criterion = nn.ClassNLLCriterion()
+    method = optim.SGD(learning_rate=0.1, momentum=0.9)
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    ostate = method.init_state(params)
+    batch = 128  # FIXED global batch: same total work for every n
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (batch, 3, 32, 32)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, (batch,)).astype(np.int32))
+    data_sh = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+    x = jax.device_put(x, data_sh)
+    y = jax.device_put(y, data_sh)
+    params = jax.tree_util.tree_map(lambda a: jax.device_put(a, repl), params)
+    mstate = jax.tree_util.tree_map(lambda a: jax.device_put(a, repl), mstate)
+    ostate = jax.tree_util.tree_map(lambda a: jax.device_put(a, repl), ostate)
+
+    def loss_fn(p, ms, x, y):
+        out, ms2 = model.apply(p, ms, x, training=True)
+        return criterion.apply(out, y), ms2
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(p, ms, os_, x, y, it):
+        (loss, ms), g = grad_fn(p, ms, x, y)
+        p, os_ = method.update(g, p, os_, 0.1, it)
+        return p, ms, os_, loss
+
+    params, mstate, ostate, loss = step(params, mstate, ostate, x, y, 0)
+    loss.block_until_ready()
+    iters = 10
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, mstate, ostate, loss = step(params, mstate, ostate, x, y, i)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(batch * iters / dt)
+
+
 if __name__ == "__main__":
-    main()
+    if "--scaling-child" in sys.argv:
+        scaling_child()
+    elif "--scaling" in sys.argv:
+        scaling()
+    else:
+        main()

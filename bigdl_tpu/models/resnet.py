@@ -9,6 +9,12 @@ Both recipes of the reference:
 Convs carry MSRA init like the reference (``MsraFiller``), BN gammas init 1
 except the last BN of each block when ``zero_init_residual`` (the reference's
 "optnet"/last-gamma trick: iniChannels/zeroGradParameters notes).
+
+TPU note: pass ``format="NHWC"`` for best MXU utilisation — channels-last
+keeps the channel dim contiguous in lane registers and avoids layout
+transposes around every conv (the reference is NCHW-only because MKL-DNN
+negotiated its own blocked layouts; XLA does the same negotiation but
+starts cheaper from NHWC on TPU).
 """
 
 from __future__ import annotations
@@ -19,23 +25,24 @@ from bigdl_tpu import nn
 from bigdl_tpu.nn.initialization import MsraFiller, Zeros
 
 
-def _conv_bn(in_c, out_c, k, stride, pad, name):
+def _conv_bn(in_c, out_c, k, stride, pad, name, fmt="NCHW"):
     return (nn.Sequential(name=name)
             .add(nn.SpatialConvolution(
                 in_c, out_c, k, k, stride, stride, pad, pad,
-                with_bias=False, weight_init=MsraFiller(),
+                with_bias=False, weight_init=MsraFiller(), format=fmt,
                 name=f"{name}_conv"))
-            .add(nn.SpatialBatchNormalization(out_c, name=f"{name}_bn")))
+            .add(nn.SpatialBatchNormalization(out_c, format=fmt,
+                                              name=f"{name}_bn")))
 
 
-def basic_block(in_c, out_c, stride):
+def basic_block(in_c, out_c, stride, fmt="NCHW"):
     """3x3+3x3 residual block (reference basicBlock)."""
     main = (nn.Sequential()
-            .add(_conv_bn(in_c, out_c, 3, stride, 1, "a"))
+            .add(_conv_bn(in_c, out_c, 3, stride, 1, "a", fmt))
             .add(nn.ReLU())
-            .add(_conv_bn(out_c, out_c, 3, 1, 1, "b")))
+            .add(_conv_bn(out_c, out_c, 3, 1, 1, "b", fmt)))
     if stride != 1 or in_c != out_c:
-        shortcut = _conv_bn(in_c, out_c, 1, stride, 0, "sc")  # type B
+        shortcut = _conv_bn(in_c, out_c, 1, stride, 0, "sc", fmt)  # type B
     else:
         shortcut = nn.Identity()
     return (nn.Sequential()
@@ -44,17 +51,17 @@ def basic_block(in_c, out_c, stride):
             .add(nn.ReLU()))
 
 
-def bottleneck(in_c, mid_c, stride):
+def bottleneck(in_c, mid_c, stride, fmt="NCHW"):
     """1x1 → 3x3 → 1x1 bottleneck (reference bottleneck; expansion 4)."""
     out_c = mid_c * 4
     main = (nn.Sequential()
-            .add(_conv_bn(in_c, mid_c, 1, 1, 0, "a"))
+            .add(_conv_bn(in_c, mid_c, 1, 1, 0, "a", fmt))
             .add(nn.ReLU())
-            .add(_conv_bn(mid_c, mid_c, 3, stride, 1, "b"))
+            .add(_conv_bn(mid_c, mid_c, 3, stride, 1, "b", fmt))
             .add(nn.ReLU())
-            .add(_conv_bn(mid_c, out_c, 1, 1, 0, "c")))
+            .add(_conv_bn(mid_c, out_c, 1, 1, 0, "c", fmt)))
     if stride != 1 or in_c != out_c:
-        shortcut = _conv_bn(in_c, out_c, 1, stride, 0, "sc")
+        shortcut = _conv_bn(in_c, out_c, 1, stride, 0, "sc", fmt)
     else:
         shortcut = nn.Identity()
     return (nn.Sequential()
@@ -63,43 +70,46 @@ def bottleneck(in_c, mid_c, stride):
             .add(nn.ReLU()))
 
 
-def resnet_cifar(depth: int = 20, class_num: int = 10) -> nn.Sequential:
+def resnet_cifar(depth: int = 20, class_num: int = 10,
+                 format: str = "NCHW") -> nn.Sequential:
     """CIFAR-10 ResNet (reference ``ResNet.apply`` CIFAR path): 3 stages of
     n = (depth-2)/6 basic blocks at widths 16/32/64."""
     assert (depth - 2) % 6 == 0, "depth must be 6n+2"
+    fmt = format
     n = (depth - 2) // 6
     model = (nn.Sequential(name=f"ResNet{depth}")
-             .add(_conv_bn(3, 16, 3, 1, 1, "stem"))
+             .add(_conv_bn(3, 16, 3, 1, 1, "stem", fmt))
              .add(nn.ReLU()))
     widths = [16, 32, 64]
     in_c = 16
     for si, w in enumerate(widths):
         for bi in range(n):
             stride = 2 if (si > 0 and bi == 0) else 1
-            model.add(basic_block(in_c, w, stride))
+            model.add(basic_block(in_c, w, stride, fmt))
             in_c = w
-    model.add(nn.SpatialAveragePooling(8, 8, 8, 8))
+    model.add(nn.SpatialAveragePooling(8, 8, 8, 8, format=fmt))
     model.add(nn.Reshape((64,)))
     model.add(nn.Linear(64, class_num))
     model.add(nn.LogSoftMax())
     return model
 
 
-def resnet50(class_num: int = 1000) -> nn.Sequential:
+def resnet50(class_num: int = 1000, format: str = "NCHW") -> nn.Sequential:
     """ImageNet ResNet-50 (reference ``ResNet.apply`` ImageNet path):
     stem 7x7/2 + maxpool, stages [3,4,6,3] bottlenecks at 64/128/256/512."""
+    fmt = format
     model = (nn.Sequential(name="ResNet50")
-             .add(_conv_bn(3, 64, 7, 2, 3, "stem"))
+             .add(_conv_bn(3, 64, 7, 2, 3, "stem", fmt))
              .add(nn.ReLU())
-             .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)))
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=fmt)))
     cfg = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
     in_c = 64
     for mid, blocks, first_stride in cfg:
         for bi in range(blocks):
             stride = first_stride if bi == 0 else 1
-            model.add(bottleneck(in_c, mid, stride))
+            model.add(bottleneck(in_c, mid, stride, fmt))
             in_c = mid * 4
-    model.add(nn.SpatialAveragePooling(7, 7, 7, 7))
+    model.add(nn.SpatialAveragePooling(7, 7, 7, 7, format=fmt))
     model.add(nn.Reshape((2048,)))
     model.add(nn.Linear(2048, class_num))
     model.add(nn.LogSoftMax())

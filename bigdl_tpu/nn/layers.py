@@ -313,8 +313,14 @@ class SpatialBatchNormalization(Module):
         ndim = input.ndim
         axes = self._axes if ndim == 4 else (0,)
         if training:
-            mean = jnp.mean(input, axis=axes)
-            var = jnp.var(input, axis=axes)
+            # one-pass stats: E[x²]-E[x]² lets XLA fuse both reductions into
+            # a single sweep over the (large) activation — jnp.var's
+            # two-pass form reads it twice.  Accumulate in f32: bf16
+            # squares lose too many bits for the cancellation.
+            xf = input.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+            var = jnp.maximum(var, 0.0)
             n = input.size / self.n_output
             unbiased = var * n / max(n - 1, 1)
             m = self.momentum
@@ -326,10 +332,14 @@ class SpatialBatchNormalization(Module):
             mean, var = state["running_mean"], state["running_var"]
             new_state = state
         inv = lax.rsqrt(var + self.eps)
-        y = (input - self._reshape(mean, ndim)) * self._reshape(inv, ndim)
+        # fold (mean, inv, gamma, beta) into one scale+shift so the big
+        # activation is touched exactly once, in its own (bf16) dtype
+        scale, shift = inv, -mean * inv
         if self.affine:
-            y = y * self._reshape(params["weight"], ndim) \
-                + self._reshape(params["bias"], ndim)
+            scale = scale * params["weight"]
+            shift = shift * params["weight"] + params["bias"]
+        y = input * self._reshape(scale.astype(input.dtype), ndim) \
+            + self._reshape(shift.astype(input.dtype), ndim)
         return y, new_state
 
 

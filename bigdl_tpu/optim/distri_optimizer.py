@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import logging
 import time
+from functools import partial
 from typing import Optional
 
 import jax
@@ -148,7 +149,11 @@ class DistriOptimizer(Optimizer):
         rng = jax.random.PRNGKey(self.seed)
         rng, init_rng = jax.random.split(rng)
         if self.model._params is not None:
-            params, mstate = self.model._params, self.model._state
+            # copy: train_step donates its inputs; without this the
+            # caller-owned model arrays would be deleted by donation
+            # (device_put below is a no-op for already-placed arrays)
+            params = jax.tree_util.tree_map(jnp.array, self.model._params)
+            mstate = jax.tree_util.tree_map(jnp.array, self.model._state)
         else:
             params, mstate = self.model.init(init_rng)
         if self._resume_opt_state is not None:
@@ -169,7 +174,8 @@ class DistriOptimizer(Optimizer):
 
         mstate_sh = tmap(lambda _: repl, mstate)
 
-        @jax.jit
+        # donated: rebound to outputs every iteration → in-place HBM update
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, mstate, ostate, x, y, lr, step, rng):
             """Global-semantics SPMD step: x/y are sharded over `data`;
             XLA inserts the grad AllReduce (params replicated) or
@@ -201,7 +207,10 @@ class DistriOptimizer(Optimizer):
                          batch.input)
                 y = tmap(lambda a: self._make_global(np.asarray(a), data_sh),
                          batch.target)
-            global_batch = batch.size()
+            # batch.size() is the PER-HOST local batch; under multi-host the
+            # assembled global array is process_count× larger, and epoch
+            # accounting compares against the GLOBAL dataset.size()
+            global_batch = batch.size() * jax.process_count()
             lr = self.optim_method.current_lr(state["neval"], state["epoch"])
             rng, step_rng = jax.random.split(rng)
             with self.metrics.time("computing"):

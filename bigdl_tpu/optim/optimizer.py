@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import time
+from functools import partial
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -275,7 +276,11 @@ class LocalOptimizer(Optimizer):
         rng = jax.random.PRNGKey(self.seed)
         rng, init_rng = jax.random.split(rng)
         if self.model._params is not None:
-            params, mstate = self.model._params, self.model._state
+            # copy: train_step donates its inputs, and these arrays are
+            # owned by the caller's model — donation would delete them,
+            # corrupting the model on a failed/interrupted run
+            params = jax.tree_util.tree_map(jnp.array, self.model._params)
+            mstate = jax.tree_util.tree_map(jnp.array, self.model._state)
         else:
             params, mstate = self.model.init(init_rng)
         if self._resume_opt_state is not None:
@@ -288,7 +293,10 @@ class LocalOptimizer(Optimizer):
         grad_clip = self.grad_clip
         optim = self.optim_method
 
-        @jax.jit
+        # donate params/mstate/ostate: they are rebound to the outputs each
+        # iteration, so XLA can update in place instead of copying ~2x the
+        # model + optimizer state through HBM every step
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, mstate, ostate, x, y, lr, step, rng):
             (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
             if grad_clip is not None:
