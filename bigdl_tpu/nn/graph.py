@@ -143,7 +143,12 @@ class Graph(Module):
             key = self._param_keys[i]
             args = [values[id(p)] for p in node.inputs]
             x = args[0] if len(args) == 1 else tuple(args)
-            out, s = node.module.apply(params[key], state[key], x,
+            # shared module instances (weight tying) share a state key: a
+            # later occurrence must see the earlier occurrence's update
+            # (running BN stats apply sequentially), not the stale input
+            # state — reference shared-instance semantics
+            cur_state = new_state.get(key, state[key])
+            out, s = node.module.apply(params[key], cur_state, x,
                                        training=training, rng=rngs[i])
             values[id(node)] = out
             new_state[key] = s

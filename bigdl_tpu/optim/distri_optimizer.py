@@ -114,6 +114,67 @@ class DistriOptimizer(Optimizer):
             return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(sharding, arr)
 
+    # ------------------------------------------- multi-host-safe val/ckpt
+    # Eval placement hooks: batches go through the same ``_make_global``
+    # path as training inputs, so validation is correct on real multi-host
+    # jobs (the base hooks feed host-local arrays into a jit against
+    # global params — single-process only).
+    #
+    # Multi-host contract: every process must see the SAME number of
+    # validation batches and identical batch shapes (the framework's own
+    # per-host dataset sharding guarantees this); the hooks issue one
+    # collective per batch, so unequal counts would deadlock.
+    def _place_eval_input(self, x):
+        n_data = self.mesh.shape["data"]
+        data_sh = NamedSharding(self.mesh, P("data"))
+        repl = NamedSharding(self.mesh, P())
+
+        def place(a):
+            a = np.asarray(a)
+            if a.shape[0] % n_data == 0:
+                return self._make_global(a, data_sh)
+            # ragged last eval batch: single-process can fall back to a
+            # replicated (unsharded but correct) forward; multi-host has
+            # no safe fallback — per-process rows differ, so a
+            # "replicated" global array would be undefined
+            if jax.process_count() > 1:
+                raise ValueError(
+                    f"multi-host validation batch of {a.shape[0]} rows is "
+                    f"not divisible by the data axis ({n_data}); use a "
+                    "divisible validation batch size (drop_remainder or "
+                    "pad)")
+            return jax.device_put(a, repl)
+
+        return tmap(place, x)
+
+    def _place_eval_target(self, t):
+        return tmap(lambda a: self._host_global(np.asarray(a)), t)
+
+    def _gather_eval_output(self, out):
+        return self._host_global(out)
+
+    def _host_global(self, arr):
+        """Globally-sharded device array → host array every process sees
+        fully (process_allgather under multi-host)."""
+        if jax.process_count() == 1:
+            return arr
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(arr, tiled=True)
+
+    def _maybe_checkpoint(self, params, mstate, ostate):
+        if not (self.checkpoint_trigger and self.checkpoint_path
+                and self.checkpoint_trigger(self.state)):
+            return
+        if jax.process_count() > 1:
+            # sharded leaves are not fully addressable on one process:
+            # allgather to host, then only process 0 writes
+            params = tmap(self._host_global, params)
+            mstate = tmap(self._host_global, mstate)
+            ostate = tmap(self._host_global, ostate)
+            if jax.process_index() != 0:
+                return
+        super()._maybe_checkpoint(params, mstate, ostate)
+
     # ------------------------------------------------------------- train
     def optimize(self):
         attempts = 0

@@ -114,8 +114,14 @@ class Optimizer:
                        methods: Sequence[ValidationMethod],
                        batch_size: Optional[int] = None) -> "Optimizer":
         self.validation_trigger = trigger
-        self.validation_dataset = dataset
         self.validation_methods = list(methods)
+        if batch_size is not None:
+            # re-batch: reference scripts pass a validation batch size
+            # (Optimizer.setValidation(batchSize) overload)
+            from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+            dataset = dataset >> SampleToMiniBatch(
+                batch_size, drop_remainder=False)
+        self.validation_dataset = dataset
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
@@ -234,6 +240,17 @@ class Optimizer:
             sched.record(first.result)
         return results
 
+    # placement hooks — DistriOptimizer overrides these for sharded /
+    # multi-host evaluation; the loop itself lives only here
+    def _place_eval_input(self, x):
+        return device_tree(x)
+
+    def _place_eval_target(self, t):
+        return device_tree(t)
+
+    def _gather_eval_output(self, out):
+        return out
+
     def evaluate_with(self, params, mstate) -> dict:
         """Forward the validation set through the model in eval mode."""
         if self._eval_fwd is None:
@@ -251,9 +268,12 @@ class Optimizer:
             if not isinstance(batch, MiniBatch):
                 raise TypeError("validation dataset must yield MiniBatch "
                                 "(attach SampleToMiniBatch)")
-            out = self._eval_fwd(params, mstate, device_tree(batch.input))
+            out = self._eval_fwd(params, mstate,
+                                 self._place_eval_input(batch.input))
+            out = self._gather_eval_output(out)
+            tgt = self._place_eval_target(batch.target)
             for m in self.validation_methods:
-                r = m(out, device_tree(batch.target))
+                r = m(out, tgt)
                 acc[m.name] = acc[m.name] + r if m.name in acc else r
         if not acc:
             raise ValueError(

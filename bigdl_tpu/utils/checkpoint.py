@@ -11,12 +11,19 @@ Here a checkpoint is one file holding (params, model_state, opt_state,
 driver_state) as numpy pytrees — device arrays are pulled to host on save
 and restored with ``jnp.asarray`` on load.  Local filesystem only (the
 reference's HDFS/S3 paths have no analog in this environment).
+
+Format: a **data-only** ``.npz`` archive (arrays + a JSON skeleton
+describing the pytree structure) — deliberately NOT pickle, so loading a
+checkpoint from an untrusted directory cannot execute code (the reference
+inherits exactly that risk from Java serialization in ``File.load``; the
+retry path auto-loads whatever ``model.N`` file is present, so the format
+must be safe by construction).
 """
 
 from __future__ import annotations
 
+import json
 import os
-import pickle
 from typing import Any, Optional
 
 import jax
@@ -31,6 +38,44 @@ def _to_host(tree):
 def _to_device(tree):
     return jax.tree_util.tree_map(
         lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)
+
+
+def _encode(tree, arrays: list):
+    """Pytree → JSON-able skeleton; array leaves appended to ``arrays``
+    and referenced by index."""
+    if isinstance(tree, dict):
+        return {"t": "dict",
+                "k": list(tree.keys()),
+                "v": [_encode(tree[k], arrays) for k in tree.keys()]}
+    if isinstance(tree, (list, tuple)):
+        return {"t": "list" if isinstance(tree, list) else "tuple",
+                "v": [_encode(x, arrays) for x in tree]}
+    if tree is None or isinstance(tree, (bool, int, float, str)):
+        return {"t": "py", "v": tree}
+    arr = np.asarray(tree)
+    if arr.dtype.name == "bfloat16":
+        # npz can't store ml_dtypes without pickle; round-trip via uint16
+        arrays.append(arr.view(np.uint16))
+        return {"t": "arr", "i": len(arrays) - 1, "d": "bfloat16"}
+    arrays.append(arr)
+    return {"t": "arr", "i": len(arrays) - 1}
+
+
+def _decode(node, arrays):
+    t = node["t"]
+    if t == "dict":
+        return {k: _decode(v, arrays) for k, v in zip(node["k"], node["v"])}
+    if t == "list":
+        return [_decode(v, arrays) for v in node["v"]]
+    if t == "tuple":
+        return tuple(_decode(v, arrays) for v in node["v"])
+    if t == "py":
+        return node["v"]
+    arr = arrays[f"a{node['i']}"]
+    if node.get("d") == "bfloat16":
+        import ml_dtypes
+        arr = arr.view(ml_dtypes.bfloat16)
+    return arr
 
 
 def save_checkpoint(path: str, params, model_state=None, opt_state=None,
@@ -48,32 +93,46 @@ def save_checkpoint(path: str, params, model_state=None, opt_state=None,
     if os.path.exists(fname) and not overwrite:
         raise FileExistsError(
             f"{fname} exists (reference: overWriteCheckpoint not set)")
-    blob = {
-        "version": 1,
-        "params": _to_host(params),
-        "model_state": _to_host(model_state) if model_state is not None else None,
-        "opt_state": _to_host(opt_state) if opt_state is not None else None,
+    arrays: list = []
+    skeleton = {
+        "version": 2,
+        "params": _encode(_to_host(params), arrays),
+        "model_state": _encode(_to_host(model_state), arrays)
+        if model_state is not None else None,
+        "opt_state": _encode(_to_host(opt_state), arrays)
+        if opt_state is not None else None,
         "driver_state": dict(driver_state) if driver_state else None,
     }
     tmp = fname + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+        # stream straight to the file: no in-memory copy of the archive
+        np.savez(f, __meta__=np.frombuffer(
+            json.dumps(skeleton).encode(), dtype=np.uint8),
+            **{f"a{i}": a for i, a in enumerate(arrays)})
     os.replace(tmp, fname)  # atomic: a crash never leaves a torn checkpoint
     return fname
 
 
 def load_checkpoint(path: str):
     """Load a checkpoint written by :func:`save_checkpoint`.  Returns a dict
-    with params/model_state/opt_state/driver_state (device arrays)."""
-    with open(path, "rb") as f:
-        blob = pickle.load(f)
+    with params/model_state/opt_state/driver_state (device arrays).
+    ``allow_pickle`` stays False: data-only by construction."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+    except (ValueError, OSError) as e:
+        raise ValueError(
+            f"{path} is not a bigdl_tpu v2 (npz) checkpoint — legacy or "
+            "foreign formats are not auto-loaded (data-only policy); "
+            f"original error: {e}") from e
+    skeleton = json.loads(bytes(arrays.pop("__meta__")).decode())
     return {
-        "params": _to_device(blob["params"]),
-        "model_state": _to_device(blob["model_state"])
-        if blob["model_state"] is not None else None,
-        "opt_state": _to_device(blob["opt_state"])
-        if blob["opt_state"] is not None else None,
-        "driver_state": blob["driver_state"],
+        "params": _to_device(_decode(skeleton["params"], arrays)),
+        "model_state": _to_device(_decode(skeleton["model_state"], arrays))
+        if skeleton["model_state"] is not None else None,
+        "opt_state": _to_device(_decode(skeleton["opt_state"], arrays))
+        if skeleton["opt_state"] is not None else None,
+        "driver_state": skeleton["driver_state"],
     }
 
 
