@@ -22,7 +22,7 @@ from bigdl_tpu.nn.layers import (
 from bigdl_tpu.nn.activations import (
     ReLU, ReLU6, Tanh, Sigmoid, SoftMax, LogSoftMax, SoftPlus, SoftSign,
     ELU, LeakyReLU, HardTanh, HardSigmoid, GELU, SiLU, PReLU, RReLU, SReLU,
-    Threshold,
+    Threshold, HardShrink, SoftShrink, LogSigmoid, SoftMin, TanhShrink,
 )
 from bigdl_tpu.nn.shape_ops import (
     Reshape, View, Flatten, Squeeze, Unsqueeze, Transpose, Contiguous,
@@ -52,4 +52,25 @@ from bigdl_tpu.nn.attention import (
 )
 from bigdl_tpu.nn.sparse import (
     LookupTableSparse, SparseLinear, SparseJoinTable, dense_to_bags,
+)
+from bigdl_tpu.nn.volumetric import (
+    VolumetricConvolution, VolumetricMaxPooling, VolumetricAveragePooling,
+    VolumetricFullConvolution,
+)
+from bigdl_tpu.nn.spatial_extras import (
+    SpatialDilatedConvolution, SpatialShareConvolution,
+    SpatialSeparableConvolution, SpatialConvolutionMap,
+    LocallyConnected1D, LocallyConnected2D, SpatialWithinChannelLRN,
+    SpatialSubtractiveNormalization, SpatialDivisiveNormalization,
+    SpatialContrastiveNormalization, SpatialDropout1D, SpatialDropout2D,
+    SpatialDropout3D, UpSampling1D, UpSampling2D, UpSampling3D,
+    ResizeBilinear, Cropping2D, Cropping3D, TemporalMaxPooling,
+)
+from bigdl_tpu.nn.tensor_extras import (
+    MM, MV, DotProduct, CrossProduct, PairwiseDistance, CosineDistance,
+    Bilinear, Cosine, Euclidean, Add, Mul, Maxout, Highway, MixtureTable,
+    MaskedSelect, Reverse, Tile, Negative, InferReshape, NarrowTable,
+    CAveTable, BifurcateSplitTable, Bottle, MapTable, GradientReversal,
+    GaussianDropout, GaussianNoise, GaussianSampler, L1Penalty,
+    NegativeEntropyPenalty, ActivityRegularization, BinaryThreshold,
 )

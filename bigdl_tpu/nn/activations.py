@@ -185,3 +185,47 @@ class Threshold(_Stateless):
 
     def _fn(self, x):
         return jnp.where(x > self.th, x, self.v)
+
+
+class HardShrink(_Stateless):
+    """(reference ``HardShrink.scala``) 0 inside [-λ, λ], identity outside."""
+
+    def __init__(self, the_lambda: float = 0.5, name=None):
+        super().__init__(name)
+        self.the_lambda = the_lambda
+
+    def _fn(self, x):
+        return jnp.where(jnp.abs(x) > self.the_lambda, x, 0.0)
+
+
+class SoftShrink(_Stateless):
+    """(reference ``SoftShrink.scala``) shrink magnitudes by λ, 0 inside."""
+
+    def __init__(self, the_lambda: float = 0.5, name=None):
+        super().__init__(name)
+        self.the_lambda = the_lambda
+
+    def _fn(self, x):
+        lam = self.the_lambda
+        return jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0))
+
+
+class LogSigmoid(_Stateless):
+    """(reference ``LogSigmoid.scala``) log(1/(1+e^-x))."""
+
+    def _fn(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class SoftMin(_Stateless):
+    """(reference ``SoftMin.scala``) softmax of -x over the last dim."""
+
+    def _fn(self, x):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class TanhShrink(_Stateless):
+    """(reference ``TanhShrink.scala``) x - tanh(x)."""
+
+    def _fn(self, x):
+        return x - jnp.tanh(x)

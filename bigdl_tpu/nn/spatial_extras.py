@@ -1,0 +1,506 @@
+"""Extended spatial layers: dilated/separable/locally-connected convs,
+LRN + classic normalizations, spatial dropouts, up/down-sampling, crops.
+
+Reference files (all under ``DL/nn/``): ``SpatialDilatedConvolution.scala``,
+``SpatialSeparableConvolution.scala``, ``SpatialShareConvolution.scala``,
+``SpatialConvolutionMap.scala``, ``LocallyConnected1D/2D.scala``,
+``SpatialWithinChannelLRN.scala``, ``SpatialSubtractiveNormalization.scala``,
+``SpatialDivisiveNormalization.scala``, ``SpatialContrastiveNormalization
+.scala``, ``SpatialDropout1D/2D/3D.scala``, ``UpSampling1D/2D/3D.scala``,
+``ResizeBilinear.scala``, ``Cropping2D/3D.scala``, ``TemporalMaxPooling
+.scala``.
+
+All NCHW (batch, channel, ...) like the reference; each layer is a thin
+``lax``/``jnp`` program — no hand-written backward (jax.grad).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.layers import SpatialConvolution
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Dilated 2-D conv (reference ``SpatialDilatedConvolution.scala``) —
+    the base conv already supports ``rhs_dilation``; the reference keeps a
+    separate class, mirrored here for script parity."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh,
+                 dw=1, dh=1, pad_w=0, pad_h=0, dilation_w=1, dilation_h=1,
+                 **kwargs):
+        super().__init__(n_input_plane, n_output_plane, kw, kh, dw, dh,
+                         pad_w, pad_h, dilation_w=dilation_w,
+                         dilation_h=dilation_h, **kwargs)
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Reference ``SpatialShareConvolution.scala`` shares im2col buffers
+    across replicas — a JVM memory optimization with no XLA analog (XLA
+    owns buffers); computationally identical to SpatialConvolution."""
+    pass
+
+
+class SpatialSeparableConvolution(Module):
+    """Depthwise conv × depth multiplier, then 1×1 pointwise (reference
+    ``SpatialSeparableConvolution.scala``)."""
+
+    def __init__(self, n_input_channel: int, n_output_channel: int,
+                 depth_multiplier: int, kw: int, kh: int,
+                 sw: int = 1, sh: int = 1, pw: int = 0, ph: int = 0,
+                 with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input_channel
+        self.n_output = n_output_channel
+        self.mult = depth_multiplier
+        self.kernel = (kh, kw)
+        self.stride = (sh, sw)
+        self.pad = (ph, pw)
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        kh, kw = self.kernel
+        mid = self.n_input * self.mult
+        params = {
+            # depthwise: (mult*in, 1, kh, kw) with groups=in
+            "depth_weight": self.weight_init.init(
+                k1, (mid, 1, kh, kw), kh * kw, self.mult * kh * kw),
+            "point_weight": self.weight_init.init(
+                k2, (self.n_output, mid, 1, 1), mid, self.n_output),
+        }
+        if self.with_bias:
+            params["bias"] = jnp.zeros((self.n_output,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ph, pw = self.pad
+        y = lax.conv_general_dilated(
+            input, params["depth_weight"], self.stride,
+            ((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_input)
+        y = lax.conv_general_dilated(
+            y, params["point_weight"], (1, 1), ((0, 0), (0, 0)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y, state
+
+
+class SpatialConvolutionMap(Module):
+    """Conv with an explicit input→output connection table (reference
+    ``SpatialConvolutionMap.scala``; LeNet-style partial connectivity).
+
+    ``conn_table``: int array (n_connections, 2) of (input_plane,
+    output_plane) pairs, 0-based.  Implemented as a dense conv with a
+    constant 0/1 mask on the weight — XLA folds the mask; semantics match
+    the reference's per-connection accumulation exactly."""
+
+    def __init__(self, conn_table, kw: int, kh: int, dw: int = 1,
+                 dh: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        import numpy as np
+        tbl = np.asarray(conn_table, int)
+        self.conn_table = tbl
+        self.n_input = int(tbl[:, 0].max()) + 1
+        self.n_output = int(tbl[:, 1].max()) + 1
+        self.kernel = (kh, kw)
+        self.stride = (dh, dw)
+        self.pad = (pad_h, pad_w)
+        self.weight_init = weight_init or RandomUniform()
+        mask = np.zeros((self.n_output, self.n_input, 1, 1), np.float32)
+        mask[tbl[:, 1], tbl[:, 0]] = 1.0
+        self._mask = mask
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input * kh * kw
+        w = self.weight_init.init(
+            k_w, (self.n_output, self.n_input, kh, kw), fan_in, fan_in)
+        return {"weight": w * self._mask,
+                "bias": jnp.zeros((self.n_output,), jnp.float32)}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        ph, pw = self.pad
+        y = lax.conv_general_dilated(
+            input, params["weight"] * self._mask, self.stride,
+            ((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return y + params["bias"][None, :, None, None], state
+
+
+def _extract_patches(x, kh, kw, sh, sw, ph, pw):
+    """(N, C, H, W) → (N, C*kh*kw, oh, ow) im2col via XLA patches."""
+    return lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), ((ph, ph), (pw, pw)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+class LocallyConnected2D(Module):
+    """Conv with UNSHARED weights per output location (reference
+    ``LocallyConnected2D.scala``).  Implemented as im2col patches +
+    einsum over per-position kernels."""
+
+    def __init__(self, n_input_plane: int, input_width: int,
+                 input_height: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input_plane
+        self.n_output = n_output_plane
+        self.in_hw = (input_height, input_width)
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+        self.out_hw = tuple(
+            (self.in_hw[i] + 2 * self.pad[i] - self.kernel[i])
+            // self.stride[i] + 1 for i in (0, 1))
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        kh, kw = self.kernel
+        oh, ow = self.out_hw
+        fan_in = self.n_input * kh * kw
+        params = {"weight": self.weight_init.init(
+            k_w, (oh, ow, self.n_output, self.n_input * kh * kw),
+            fan_in, self.n_output)}
+        if self.with_bias:
+            params["bias"] = jnp.zeros((self.n_output, oh, ow), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        patches = _extract_patches(input, kh, kw, sh, sw, ph, pw)
+        # patches: (N, C*kh*kw, oh, ow); weight: (oh, ow, O, C*kh*kw)
+        y = jnp.einsum("nkhw,hwok->nohw", patches, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"][None]
+        return y, state
+
+
+class LocallyConnected1D(Module):
+    """1-D locally-connected layer over (N, T, C) sequences (reference
+    ``LocallyConnected1D.scala``)."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input_frame = n_input_frame
+        self.in_size = input_frame_size
+        self.out_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+        self.n_output_frame = (n_input_frame - kernel_w) // stride_w + 1
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        fan_in = self.in_size * self.kernel_w
+        params = {"weight": self.weight_init.init(
+            k_w, (self.n_output_frame, self.out_size,
+                  self.kernel_w * self.in_size), fan_in, self.out_size)}
+        if self.with_bias:
+            params["bias"] = jnp.zeros(
+                (self.n_output_frame, self.out_size), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        # input (N, T, C) → windows (N, oT, kw*C)
+        idx = (jnp.arange(self.n_output_frame)[:, None] * self.stride_w
+               + jnp.arange(self.kernel_w)[None, :])
+        win = input[:, idx]  # (N, oT, kw, C)
+        win = win.reshape(win.shape[0], self.n_output_frame, -1)
+        y = jnp.einsum("ntk,tok->nto", win, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"][None]
+        return y, state
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN over a spatial window within each channel (reference
+    ``SpatialWithinChannelLRN.scala``):
+    ``y = x / (1 + alpha/size^2 * avgpool(x^2, size))^beta``."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, name: Optional[str] = None):
+        super().__init__(name)
+        assert size % 2 == 1, "LRN size must be odd"
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        s = self.size
+        p = s // 2
+        sq = input * input
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add, (1, 1, s, s), (1, 1, 1, 1),
+            ((0, 0), (0, 0), (p, p), (p, p)))
+        denom = (1.0 + (self.alpha / (s * s)) * summed) ** self.beta
+        return input / denom, state
+
+
+def _gaussian_kernel2d(size: int, sigma: float = None):
+    import numpy as np
+    if sigma is None:
+        sigma = 0.3 * ((size - 1) * 0.5 - 1) + 0.8
+    ax = np.arange(size) - (size - 1) / 2.0
+    g = np.exp(-(ax ** 2) / (2 * sigma ** 2))
+    k = np.outer(g, g)
+    return (k / k.sum()).astype(np.float32)
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract a weighted neighbourhood mean (reference
+    ``SpatialSubtractiveNormalization.scala``; default kernel = gaussian).
+    The kernel is averaged across input channels like the reference."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input = n_input_plane
+        import numpy as np
+        k = _gaussian_kernel2d(9) if kernel is None \
+            else np.asarray(kernel, np.float32)
+        k = k / (k.sum() * n_input_plane)
+        self._kernel = k
+
+    def _local_mean(self, input):
+        kh, kw = self._kernel.shape
+        ph, pw = kh // 2, kw // 2
+        w = jnp.asarray(self._kernel)[None, None].repeat(self.n_input, 1)
+        mean = lax.conv_general_dilated(
+            input, w, (1, 1), ((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # normalize by the actually-covered coefficient sum at borders
+        ones = jnp.ones((1, self.n_input) + input.shape[2:], input.dtype)
+        coef = lax.conv_general_dilated(
+            ones, w, (1, 1), ((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return mean / coef
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input - self._local_mean(input), state
+
+
+class SpatialDivisiveNormalization(SpatialSubtractiveNormalization):
+    """Divide by the neighbourhood standard deviation (reference
+    ``SpatialDivisiveNormalization.scala``); thresholded at the global
+    mean std like the reference."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        local_std = jnp.sqrt(jnp.maximum(
+            self._local_mean(input * input), 0.0))
+        mean_std = jnp.mean(local_std, axis=(2, 3), keepdims=True)
+        denom = jnp.maximum(local_std, mean_std)
+        denom = jnp.where(denom < 1e-8, 1.0, denom)
+        return input / denom, state
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization (reference
+    ``SpatialContrastiveNormalization.scala``)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y, _ = self.sub.apply({}, {}, input, training=training)
+        y, _ = self.div.apply({}, {}, y, training=training)
+        return y, state
+
+
+class _ChannelDropout(Module):
+    """Drop whole feature maps (keeps XLA shapes static; scaling matches
+    torch SpatialDropout — NO 1/p rescale in the reference, which follows
+    Torch's nn.SpatialDropout: masks only)."""
+
+    axes_after_channel: int = 2
+
+    def __init__(self, init_p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name)
+        self.p = init_p
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return input, state
+        if rng is None:
+            raise ValueError(f"{self.name}: training needs rng")
+        mask_shape = input.shape[:2] + (1,) * (input.ndim - 2)
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, mask_shape)
+        return input * keep.astype(input.dtype), state
+
+
+class SpatialDropout1D(_ChannelDropout):
+    """(N, T, C): drops channels (last dim), reference
+    ``SpatialDropout1D.scala``."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return input, state
+        if rng is None:
+            raise ValueError(f"{self.name}: training needs rng")
+        mask_shape = (input.shape[0], 1, input.shape[2])
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, mask_shape)
+        return input * keep.astype(input.dtype), state
+
+
+class SpatialDropout2D(_ChannelDropout):
+    """(N, C, H, W), reference ``SpatialDropout2D.scala``."""
+    pass
+
+
+class SpatialDropout3D(_ChannelDropout):
+    """(N, C, D, H, W), reference ``SpatialDropout3D.scala``."""
+    pass
+
+
+class UpSampling1D(Module):
+    """Repeat each timestep ``length`` times, (N, T, C) (reference
+    ``UpSampling1D.scala``)."""
+
+    def __init__(self, length: int = 2, name=None):
+        super().__init__(name)
+        self.length = length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.repeat(input, self.length, axis=1), state
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbour upsample (N, C, H, W) (reference
+    ``UpSampling2D.scala``)."""
+
+    def __init__(self, size: Sequence[int] = (2, 2), name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y = jnp.repeat(input, self.size[0], axis=2)
+        return jnp.repeat(y, self.size[1], axis=3), state
+
+
+class UpSampling3D(Module):
+    """(N, C, D, H, W) nearest upsample (reference ``UpSampling3D.scala``)."""
+
+    def __init__(self, size: Sequence[int] = (2, 2, 2), name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y = input
+        for ax, s in zip((2, 3, 4), self.size):
+            y = jnp.repeat(y, s, axis=ax)
+        return y, state
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize of NCHW images (reference ``ResizeBilinear.scala``;
+    align_corners supported)."""
+
+    def __init__(self, out_height: int, out_width: int,
+                 align_corners: bool = False, name=None):
+        super().__init__(name)
+        self.out_hw = (out_height, out_width)
+        self.align_corners = align_corners
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        n, c, h, w = input.shape
+        oh, ow = self.out_hw
+        if self.align_corners and oh > 1 and ow > 1:
+            ys = jnp.linspace(0.0, h - 1.0, oh)
+            xs = jnp.linspace(0.0, w - 1.0, ow)
+        else:
+            # half-pixel-free TF1 semantics like the reference:
+            # src = dst * scale
+            ys = jnp.arange(oh) * (h / oh)
+            xs = jnp.arange(ow) * (w / ow)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0).astype(input.dtype)
+        wx = (xs - x0).astype(input.dtype)
+        a = input[:, :, y0][:, :, :, x0]
+        b = input[:, :, y0][:, :, :, x1]
+        c_ = input[:, :, y1][:, :, :, x0]
+        d = input[:, :, y1][:, :, :, x1]
+        wy = wy[None, None, :, None]
+        wx = wx[None, None, None, :]
+        top = a * (1 - wx) + b * wx
+        bot = c_ * (1 - wx) + d * wx
+        return top * (1 - wy) + bot * wy, state
+
+
+class Cropping2D(Module):
+    """Crop rows/cols off a (N, C, H, W) tensor (reference
+    ``Cropping2D.scala``)."""
+
+    def __init__(self, height_crop=(0, 0), width_crop=(0, 0), name=None):
+        super().__init__(name)
+        self.hc = tuple(height_crop)
+        self.wc = tuple(width_crop)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        h, w = input.shape[2], input.shape[3]
+        return input[:, :, self.hc[0]:h - self.hc[1] or None,
+                     self.wc[0]:w - self.wc[1] or None], state
+
+
+class Cropping3D(Module):
+    """Crop a (N, C, D, H, W) tensor (reference ``Cropping3D.scala``)."""
+
+    def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0),
+                 dim3_crop=(0, 0), name=None):
+        super().__init__(name)
+        self.crops = (tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        d, h, w = input.shape[2:]
+        (d0, d1), (h0, h1), (w0, w1) = self.crops
+        return input[:, :, d0:d - d1 or None, h0:h - h1 or None,
+                     w0:w - w1 or None], state
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pooling over (N, T, C) (reference
+    ``TemporalMaxPooling.scala``)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None, name=None):
+        super().__init__(name)
+        self.k = k_w
+        self.d = d_w or k_w
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y = lax.reduce_window(
+            input, -jnp.inf, lax.max, (1, self.k, 1), (1, self.d, 1),
+            ((0, 0), (0, 0), (0, 0)))
+        return y, state

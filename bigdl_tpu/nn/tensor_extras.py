@@ -1,0 +1,576 @@
+"""Table-op, distance/similarity, and stochastic-regularization layers.
+
+Reference files (``DL/nn/``): ``MM.scala``, ``MV.scala``,
+``DotProduct.scala``, ``CrossProduct.scala``, ``PairwiseDistance.scala``,
+``CosineDistance.scala``, ``Bilinear.scala``, ``Cosine.scala``,
+``Euclidean.scala``, ``Add.scala``, ``Mul.scala``, ``Maxout.scala``,
+``Highway.scala``, ``MixtureTable.scala``, ``MaskedSelect.scala``,
+``Reverse.scala``, ``Tile.scala``, ``Negative.scala``,
+``InferReshape.scala``, ``NarrowTable.scala``, ``CAveTable.scala``,
+``BifurcateSplitTable.scala``, ``GradientReversal.scala``,
+``GaussianDropout.scala``, ``GaussianNoise.scala``,
+``GaussianSampler.scala``, ``L1Penalty.scala``,
+``NegativeEntropyPenalty.scala``, ``ActivityRegularization.scala``,
+``BinaryThreshold.scala``, ``Bottle.scala``, ``MapTable.scala``,
+``CrossProduct.scala``.
+
+Tables are Python tuples/lists (pytrees).  Penalty layers (L1Penalty &
+co) diverge from the reference's mutable ``loss`` field: they are
+identity in ``apply`` and expose ``penalty(input)`` — add it to the
+criterion (the functional equivalent of the reference adding the penalty
+during ``updateOutput``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+
+
+# ------------------------------------------------------------- table math
+class MM(Module):
+    """Batched matmul of a 2-table (reference ``MM.scala``; transA/B)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 name=None):
+        super().__init__(name)
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b), state
+
+
+class MV(Module):
+    """Batched matrix×vector (reference ``MV.scala``)."""
+
+    def __init__(self, trans: bool = False, name=None):
+        super().__init__(name)
+        self.trans = trans
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        m, v = input
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), state
+
+
+class DotProduct(Module):
+    """Row-wise dot product of two inputs (reference ``DotProduct.scala``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        return jnp.sum(a * b, axis=-1), state
+
+
+class CrossProduct(Module):
+    """All pairwise dot products between table entries (reference
+    ``CrossProduct.scala``; Deep&Cross-style feature crossing).
+    Output (N, K*(K-1)/2) in (i<j) order."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs = []
+        for i in range(len(input)):
+            for j in range(i + 1, len(input)):
+                outs.append(jnp.sum(input[i] * input[j], axis=-1))
+        return jnp.stack(outs, axis=-1), state
+
+
+class PairwiseDistance(Module):
+    """p-norm distance between two inputs (reference
+    ``PairwiseDistance.scala``)."""
+
+    def __init__(self, norm: int = 2, name=None):
+        super().__init__(name)
+        self.norm = norm
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        d = jnp.sum(jnp.abs(a - b) ** self.norm, axis=-1) \
+            ** (1.0 / self.norm)
+        return d, state
+
+
+class CosineDistance(Module):
+    """Cosine similarity of two inputs (reference ``CosineDistance.scala``
+    — despite the name it outputs similarity, like Torch)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        a, b = input
+        eps = 1e-12
+        na = jnp.maximum(jnp.linalg.norm(a, axis=-1), eps)
+        nb = jnp.maximum(jnp.linalg.norm(b, axis=-1), eps)
+        return jnp.sum(a * b, axis=-1) / (na * nb), state
+
+
+# --------------------------------------------------- parameterized distances
+class Bilinear(Module):
+    """y_o = x1ᵀ W_o x2 + b_o over a 2-table (reference ``Bilinear.scala``)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name=None):
+        super().__init__(name)
+        self.in1, self.in2, self.out = input_size1, input_size2, output_size
+        self.bias_res = bias_res
+        self.weight_init = weight_init or RandomUniform()
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        fan_in = self.in1 * self.in2
+        params = {"weight": self.weight_init.init(
+            k_w, (self.out, self.in1, self.in2), fan_in, self.out)}
+        if self.bias_res:
+            params["bias"] = jnp.zeros((self.out,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x1, x2 = input
+        y = jnp.einsum("ni,oij,nj->no", x1, params["weight"], x2)
+        if self.bias_res:
+            y = y + params["bias"]
+        return y, state
+
+
+class Cosine(Module):
+    """Cosine similarity against each weight row (reference
+    ``Cosine.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.weight_init = weight_init or RandomUniform()
+
+    def init(self, rng):
+        w = self.weight_init.init(rng, (self.output_size, self.input_size),
+                                  self.input_size, self.output_size)
+        return {"weight": w}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        w = params["weight"]
+        eps = 1e-12
+        xn = jnp.maximum(jnp.linalg.norm(input, axis=-1, keepdims=True), eps)
+        wn = jnp.maximum(jnp.linalg.norm(w, axis=-1), eps)
+        return (input @ w.T) / xn / wn, state
+
+
+class Euclidean(Module):
+    """L2 distance to each weight column (reference ``Euclidean.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.weight_init = weight_init or RandomUniform()
+
+    def init(self, rng):
+        w = self.weight_init.init(rng, (self.output_size, self.input_size),
+                                  self.input_size, self.output_size)
+        return {"weight": w}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        diff = input[:, None, :] - params["weight"][None]
+        return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 1e-24)), state
+
+
+class Add(Module):
+    """Learnable bias add (reference ``Add.scala``)."""
+
+    def __init__(self, input_size: int, name=None):
+        super().__init__(name)
+        self.input_size = input_size
+
+    def init(self, rng):
+        return {"bias": jnp.zeros((self.input_size,), jnp.float32)}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input + params["bias"], state
+
+
+class Mul(Module):
+    """Single learnable scalar gain (reference ``Mul.scala``)."""
+
+    def init(self, rng):
+        return {"weight": jnp.ones((), jnp.float32)}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input * params["weight"], state
+
+
+class Maxout(Module):
+    """Linear with ``pool`` pieces, max over pieces (reference
+    ``Maxout.scala``)."""
+
+    def __init__(self, input_size: int, output_size: int, pool: int,
+                 with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name=None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.pool = pool
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        params = {"weight": self.weight_init.init(
+            k_w, (self.pool * self.output_size, self.input_size),
+            self.input_size, self.output_size)}
+        if self.with_bias:
+            params["bias"] = jnp.zeros(
+                (self.pool * self.output_size,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        y = input @ params["weight"].T
+        if self.with_bias:
+            y = y + params["bias"]
+        y = y.reshape(y.shape[0], self.pool, self.output_size)
+        return jnp.max(y, axis=1), state
+
+
+class Highway(Module):
+    """Highway network block: t·g(Wx) + (1-t)·x (reference
+    ``Highway.scala``; t = sigmoid gate, g default tanh)."""
+
+    def __init__(self, size: int, with_bias: bool = True, activation=None,
+                 weight_init: Optional[InitializationMethod] = None,
+                 name=None):
+        super().__init__(name)
+        self.size = size
+        self.with_bias = with_bias
+        self.activation = activation or jnp.tanh
+        self.weight_init = weight_init or RandomUniform()
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "gate_weight": self.weight_init.init(
+                k1, (self.size, self.size), self.size, self.size),
+            "weight": self.weight_init.init(
+                k2, (self.size, self.size), self.size, self.size),
+        }
+        if self.with_bias:
+            # gate bias init negative like common practice? reference uses
+            # zeros — match the reference
+            params["gate_bias"] = jnp.zeros((self.size,), jnp.float32)
+            params["bias"] = jnp.zeros((self.size,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        t = input @ params["gate_weight"].T
+        h = input @ params["weight"].T
+        if self.with_bias:
+            t = t + params["gate_bias"]
+            h = h + params["bias"]
+        t = jax.nn.sigmoid(t)
+        return t * self.activation(h) + (1 - t) * input, state
+
+
+# ------------------------------------------------------------ table utils
+class MixtureTable(Module):
+    """Mixture-of-experts blend: (gater (N,K), experts) → Σ g_k·e_k
+    (reference ``MixtureTable.scala``).  Experts: K-tuple of (N, ...)
+    tensors or one (N, K, ...) tensor."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        gater, experts = input
+        if isinstance(experts, (list, tuple)):
+            experts = jnp.stack(experts, axis=1)
+        g = gater.reshape(gater.shape + (1,) * (experts.ndim - 2))
+        return jnp.sum(g * experts, axis=1), state
+
+
+class MaskedSelect(Module):
+    """Select elements where mask≠0 (reference ``MaskedSelect.scala``).
+
+    DYNAMIC output shape — usable eagerly / on host, NOT under jit (XLA
+    requires static shapes; the reference's use sites are host-side too)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x, mask = input
+        return x[mask.astype(bool)], state
+
+
+class Reverse(Module):
+    """Flip along a dim (reference ``Reverse.scala``; dim 0-based here)."""
+
+    def __init__(self, dim: int = 0, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return jnp.flip(input, axis=self.dim), state
+
+
+class Tile(Module):
+    """Repeat ``copies`` times along ``dim`` (reference ``Tile.scala``)."""
+
+    def __init__(self, dim: int = 0, copies: int = 2, name=None):
+        super().__init__(name)
+        self.dim = dim
+        self.copies = copies
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        reps = [1] * input.ndim
+        reps[self.dim] = self.copies
+        return jnp.tile(input, reps), state
+
+
+class Negative(Module):
+    """y = -x (reference ``Negative.scala``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return -input, state
+
+
+class InferReshape(Module):
+    """Reshape with -1 inference and 0 = copy-input-dim (reference
+    ``InferReshape.scala``)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False,
+                 name=None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        out = []
+        for i, s in enumerate(self.size):
+            out.append(in_shape[i] if s == 0 else s)
+        if self.batch_mode:
+            out = [input.shape[0]] + out
+        return input.reshape(tuple(out)), state
+
+
+class NarrowTable(Module):
+    """Slice a table (reference ``NarrowTable.scala``; offset 0-based)."""
+
+    def __init__(self, offset: int, length: int = 1, name=None):
+        super().__init__(name)
+        self.offset = offset
+        self.length = length
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        out = tuple(input[self.offset:self.offset + self.length])
+        return out[0] if self.length == 1 else out, state
+
+
+class CAveTable(Module):
+    """Elementwise average of table entries (reference ``CAveTable.scala``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return sum(input) / len(input), state
+
+
+class BifurcateSplitTable(Module):
+    """Split a tensor in half along ``dim`` into a 2-table (reference
+    ``BifurcateSplitTable.scala``)."""
+
+    def __init__(self, dim: int, name=None):
+        super().__init__(name)
+        self.dim = dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        half = input.shape[self.dim] // 2
+        a = jax.lax.slice_in_dim(input, 0, half, axis=self.dim)
+        b = jax.lax.slice_in_dim(input, half, input.shape[self.dim],
+                                 axis=self.dim)
+        return (a, b), state
+
+
+class Bottle(Module):
+    """Flatten leading dims, apply inner module, unflatten (reference
+    ``Bottle.scala``; n_input_dims=2 semantics: (N, T, C) → (N*T, C))."""
+
+    def __init__(self, module: Module, n_input_dims: int = 2, name=None):
+        super().__init__(name)
+        self.module = module
+        self.n_input_dims = n_input_dims
+
+    def init(self, rng):
+        return self.module.init(rng)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        lead = input.shape[:-(self.n_input_dims - 1)] \
+            if self.n_input_dims > 1 else input.shape
+        flat = input.reshape((-1,) + input.shape[len(lead):])
+        y, new_state = self.module.apply(params, state, flat,
+                                         training=training, rng=rng)
+        return y.reshape(lead + y.shape[1:]), new_state
+
+
+class MapTable(Module):
+    """Apply one module (shared weights) to every table entry (reference
+    ``MapTable.scala``)."""
+
+    def __init__(self, module: Module, name=None):
+        super().__init__(name)
+        self.module = module
+
+    def init(self, rng):
+        return self.module.init(rng)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        outs = []
+        new_state = state
+        for i, x in enumerate(input):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y, new_state = self.module.apply(params, new_state, x,
+                                             training=training, rng=r)
+            outs.append(y)
+        return tuple(outs), new_state
+
+
+# --------------------------------------------------- gradient / stochastic
+class GradientReversal(Module):
+    """Identity forward, -λ·grad backward (reference
+    ``GradientReversal.scala``; domain-adversarial training)."""
+
+    def __init__(self, the_lambda: float = 1.0, name=None):
+        super().__init__(name)
+        self.the_lambda = the_lambda
+
+        @jax.custom_vjp
+        def _rev(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (-self.the_lambda * g,)
+
+        _rev.defvjp(fwd, bwd)
+        self._rev = _rev
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._rev(input), state
+
+
+class GaussianDropout(Module):
+    """Multiplicative N(1, p/(1-p)) noise in training (reference
+    ``GaussianDropout.scala``)."""
+
+    def __init__(self, rate: float, name=None):
+        super().__init__(name)
+        assert 0.0 <= rate < 1.0
+        self.rate = rate
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training or self.rate == 0.0:
+            return input, state
+        if rng is None:
+            raise ValueError(f"{self.name}: training needs rng")
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(rng, input.shape, input.dtype)
+        return input * noise, state
+
+
+class GaussianNoise(Module):
+    """Additive N(0, σ) noise in training (reference
+    ``GaussianNoise.scala``)."""
+
+    def __init__(self, stddev: float, name=None):
+        super().__init__(name)
+        self.stddev = stddev
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if not training:
+            return input, state
+        if rng is None:
+            raise ValueError(f"{self.name}: training needs rng")
+        return input + self.stddev * jax.random.normal(
+            rng, input.shape, input.dtype), state
+
+
+class GaussianSampler(Module):
+    """VAE reparameterization: (mean, log_var) → mean + exp(lv/2)·ε
+    (reference ``GaussianSampler.scala``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        mean, log_var = input
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng")
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps, state
+
+
+# ------------------------------------------------------- penalty layers
+class L1Penalty(Module):
+    """Identity with an L1 activity penalty (reference
+    ``L1Penalty.scala``); add ``penalty(x)`` to the loss."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 name=None):
+        super().__init__(name)
+        self.l1weight = l1weight
+        self.size_average = size_average
+
+    def penalty(self, input):
+        p = self.l1weight * jnp.sum(jnp.abs(input))
+        return p / input.shape[0] if self.size_average else p
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class NegativeEntropyPenalty(Module):
+    """Identity with a -H(p) penalty encouraging diversity (reference
+    ``NegativeEntropyPenalty.scala``)."""
+
+    def __init__(self, beta: float = 0.01, name=None):
+        super().__init__(name)
+        self.beta = beta
+
+    def penalty(self, input):
+        return self.beta * jnp.sum(input * jnp.log(input + 1e-12))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class ActivityRegularization(Module):
+    """Identity with L1+L2 activity penalties (reference
+    ``ActivityRegularization.scala``)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0, name=None):
+        super().__init__(name)
+        self.l1 = l1
+        self.l2 = l2
+
+    def penalty(self, input):
+        return (self.l1 * jnp.sum(jnp.abs(input))
+                + self.l2 * jnp.sum(input * input))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return input, state
+
+
+class BinaryThreshold(Module):
+    """x > th → 1 else 0 (reference ``BinaryThreshold.scala``)."""
+
+    def __init__(self, th: float = 1e-6, name=None):
+        super().__init__(name)
+        self.th = th
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return (input > self.th).astype(input.dtype), state

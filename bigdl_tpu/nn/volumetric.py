@@ -1,0 +1,177 @@
+"""Volumetric (3-D) layers.
+
+Reference: ``DL/nn/VolumetricConvolution.scala``,
+``VolumetricMaxPooling.scala``, ``VolumetricAveragePooling.scala``,
+``VolumetricFullConvolution.scala`` — the video/3D family.  The reference
+hand-writes vol2col + gemm loops; here each is one ``lax`` op that XLA
+tiles onto the MXU.
+
+Layout is NCDHW (batch, channel, time/depth, height, width), matching the
+reference's (batch, plane, time, height, width).  Constructor argument
+order follows the reference: kernel/stride/pad given as (T, W, H).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform
+
+_DIMS = ("NCDHW", "OIDHW", "NCDHW")
+
+
+class VolumetricConvolution(Module):
+    """3-D convolution (reference ``VolumetricConvolution.scala``:
+    vol2col + gemm → one ``lax.conv_general_dilated``)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+        self.bias_init = bias_init or RandomUniform()
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        kt, kh, kw = self.kernel
+        fan_in = self.n_input_plane * kt * kh * kw
+        fan_out = self.n_output_plane * kt * kh * kw
+        w_shape = (self.n_output_plane, self.n_input_plane, kt, kh, kw)
+        params = {"weight": self.weight_init.init(k_w, w_shape,
+                                                  fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(
+                k_b, (self.n_output_plane,), fan_in, fan_out)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        pt, ph, pw = self.pad
+        y = lax.conv_general_dilated(
+            input, params["weight"],
+            window_strides=self.stride,
+            padding=((pt, pt), (ph, ph), (pw, pw)),
+            dimension_numbers=_DIMS)
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None, None]
+        return y, state
+
+
+class _VolPool(Module):
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None,
+                 d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+
+    def _window(self):
+        dims = (1, 1) + self.kernel
+        strides = (1, 1) + self.stride
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in self.pad)
+        return dims, strides, pads
+
+
+class VolumetricMaxPooling(_VolPool):
+    """3-D max pooling (reference ``VolumetricMaxPooling.scala``)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        dims, strides, pads = self._window()
+        y = lax.reduce_window(input, -jnp.inf, lax.max, dims, strides, pads)
+        return y, state
+
+
+class VolumetricAveragePooling(_VolPool):
+    """3-D average pooling (reference ``VolumetricAveragePooling.scala``;
+    countIncludePad=true semantics)."""
+
+    def __init__(self, *args, count_include_pad: bool = True, **kw):
+        super().__init__(*args, **kw)
+        self.count_include_pad = count_include_pad
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        dims, strides, pads = self._window()
+        summed = lax.reduce_window(input, 0.0, lax.add, dims, strides, pads)
+        if self.count_include_pad:
+            y = summed / float(jnp.prod(jnp.array(self.kernel)))
+        else:
+            counts = lax.reduce_window(jnp.ones_like(input), 0.0, lax.add,
+                                       dims, strides, pads)
+            y = summed / jnp.maximum(counts, 1.0)
+        return y, state
+
+
+class VolumetricFullConvolution(Module):
+    """Transposed 3-D convolution (reference
+    ``VolumetricFullConvolution.scala``); output size =
+    (in-1)*stride - 2*pad + kernel + adj per spatial dim."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 with_bias: bool = True,
+                 weight_init: Optional[InitializationMethod] = None,
+                 bias_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.adj = (adj_t, adj_h, adj_w)
+        self.with_bias = with_bias
+        self.weight_init = weight_init or RandomUniform()
+        self.bias_init = bias_init or RandomUniform()
+
+    def init(self, rng):
+        k_w, k_b = jax.random.split(rng)
+        kt, kh, kw = self.kernel
+        fan_in = self.n_input_plane * kt * kh * kw
+        fan_out = self.n_output_plane * kt * kh * kw
+        # IODHW like the reference's (input, output, kT, kH, kW)
+        w_shape = (self.n_input_plane, self.n_output_plane, kt, kh, kw)
+        params = {"weight": self.weight_init.init(k_w, w_shape,
+                                                  fan_in, fan_out)}
+        if self.with_bias:
+            params["bias"] = self.bias_init.init(
+                k_b, (self.n_output_plane,), fan_in, fan_out)
+        return params, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        # fractionally-strided conv: dilate input by stride, convolve with
+        # the flipped kernel (IODHW → OIDHW)
+        w = jnp.transpose(jnp.flip(params["weight"], axis=(2, 3, 4)),
+                          (1, 0, 2, 3, 4))
+        pads = tuple(
+            (k - 1 - p, k - 1 - p + a)
+            for k, p, a in zip(self.kernel, self.pad, self.adj))
+        y = lax.conv_general_dilated(
+            input, w,
+            window_strides=(1, 1, 1),
+            padding=pads,
+            lhs_dilation=self.stride,
+            dimension_numbers=_DIMS)
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None, None]
+        return y, state

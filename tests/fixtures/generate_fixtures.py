@@ -1,0 +1,237 @@
+"""Golden-fixture generator — torch (CPU) is the independent oracle.
+
+Analog of the reference's Torch7 parity harness (``TEST/torch/TH.scala:
+35-44``: write inputs as .t7, shell out to ``th``, read results back).
+Here: initialize the bigdl_tpu layer's params, copy them into the
+equivalent torch module, record (input, params, output, grad_input,
+grad_params) as an npz fixture.  ``tests/test_fixture_parity.py`` replays
+every fixture against the JAX layer — forward AND backward — so layer
+semantics are pinned to an independently-computed reference, not to
+whatever the implementation happens to produce.
+
+Run from the repo root:  python tests/fixtures/generate_fixtures.py
+Regenerates tests/fixtures/data/*.npz deterministically (seeded).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import torch  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+CASES = {}
+
+
+def case(name):
+    def deco(fn):
+        CASES[name] = fn
+        return fn
+    return deco
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x), dtype=torch.float64,
+                        requires_grad=False)
+
+
+def _record(name, params, x, torch_fwd, extra_inputs=None):
+    """Run torch_fwd(params as torch tensors, x) -> out; record fixture.
+
+    grad targets: d(sum(out))/d(x) and /d(each param).
+    All torch math in float64 so the fixture is a high-precision oracle;
+    the replay asserts float32-level tolerance.
+    """
+    tp = {k: _t(v).requires_grad_(True) for k, v in params.items()}
+    tx = _t(x).requires_grad_(True)
+    out = torch_fwd(tp, tx)
+    loss = out.sum()
+    loss.backward()
+    blob = {
+        "x": np.asarray(x, np.float64),
+        "out": out.detach().numpy(),
+        "dx": tx.grad.numpy(),
+    }
+    for k, v in params.items():
+        blob[f"p_{k}"] = np.asarray(v, np.float64)
+        blob[f"dp_{k}"] = tp[k].grad.numpy()
+    os.makedirs(DATA_DIR, exist_ok=True)
+    np.savez(os.path.join(DATA_DIR, f"{name}.npz"), **blob)
+    print(f"  {name}: out{tuple(out.shape)}")
+
+
+# --------------------------------------------------------------- conv 3D
+@case("volumetric_convolution")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 5, 8, 7))
+    params = {"weight": rng.normal(0, 0.1, (4, 3, 2, 3, 3)),
+              "bias": rng.normal(0, 0.1, (4,))}
+
+    def fwd(p, x):
+        # our kernel is (O, I, kT, kH, kW); torch conv3d wants
+        # (O, I, kT, kH, kW) with input (N, C, D, H, W) — same layout
+        return F.conv3d(x, p["weight"], p["bias"], stride=(1, 2, 2),
+                        padding=(0, 1, 1))
+    _record("volumetric_convolution", params, x, fwd)
+
+
+@case("volumetric_max_pooling")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 6, 8, 8))
+    _record("volumetric_max_pooling", {}, x,
+            lambda p, x: F.max_pool3d(x, (2, 2, 2), stride=(2, 2, 2),
+                                      padding=0))
+
+
+@case("volumetric_avg_pooling")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 6, 8, 8))
+    _record("volumetric_avg_pooling", {}, x,
+            lambda p, x: F.avg_pool3d(x, (2, 2, 2), stride=(2, 2, 2)))
+
+
+@case("volumetric_full_convolution")
+def _(rng):
+    x = rng.normal(0, 1, (2, 4, 4, 5, 5))
+    params = {"weight": rng.normal(0, 0.1, (4, 3, 2, 3, 3)),  # (I,O,kT,kH,kW)
+              "bias": rng.normal(0, 0.1, (3,))}
+
+    def fwd(p, x):
+        return F.conv_transpose3d(x, p["weight"], p["bias"],
+                                  stride=(2, 2, 2), padding=(0, 1, 1),
+                                  output_padding=(1, 0, 0))
+    _record("volumetric_full_convolution", params, x, fwd)
+
+
+# ---------------------------------------------------------- spatial extras
+@case("spatial_dilated_convolution")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 9, 9))
+    params = {"weight": rng.normal(0, 0.1, (5, 3, 3, 3)),
+              "bias": rng.normal(0, 0.1, (5,))}
+    _record("spatial_dilated_convolution", params, x,
+            lambda p, x: F.conv2d(x, p["weight"], p["bias"], stride=1,
+                                  padding=2, dilation=2))
+
+
+@case("spatial_separable_convolution")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 8, 8))
+    params = {"depth_weight": rng.normal(0, 0.1, (6, 1, 3, 3)),
+              "point_weight": rng.normal(0, 0.1, (4, 6, 1, 1)),
+              "bias": rng.normal(0, 0.1, (4,))}
+
+    def fwd(p, x):
+        y = F.conv2d(x, p["depth_weight"], None, stride=1, padding=1,
+                     groups=3)
+        return F.conv2d(y, p["point_weight"], p["bias"])
+    _record("spatial_separable_convolution", params, x, fwd)
+
+
+@case("locally_connected_2d")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 6, 6))
+    kh = kw = 3
+    oh = ow = 4  # (6 - 3)//1 + 1
+    params = {"weight": rng.normal(0, 0.1, (oh, ow, 4, 3 * kh * kw)),
+              "bias": rng.normal(0, 0.1, (4, oh, ow))}
+
+    def fwd(p, x):
+        patches = F.unfold(x, (kh, kw))  # (N, C*kh*kw, L)
+        patches = patches.reshape(x.shape[0], -1, oh, ow)
+        y = torch.einsum("nkhw,hwok->nohw", patches, p["weight"])
+        return y + p["bias"][None]
+    _record("locally_connected_2d", params, x, fwd)
+
+
+@case("locally_connected_1d")
+def _(rng):
+    x = rng.normal(0, 1, (2, 7, 5))  # (N, T, C)
+    kw, stride, ot = 3, 2, 3  # (7-3)//2+1
+    params = {"weight": rng.normal(0, 0.1, (ot, 4, kw * 5)),
+              "bias": rng.normal(0, 0.1, (ot, 4))}
+
+    def fwd(p, x):
+        wins = torch.stack([x[:, t * stride:t * stride + kw].reshape(
+            x.shape[0], -1) for t in range(ot)], dim=1)  # (N, oT, kw*C)
+        y = torch.einsum("ntk,tok->nto", wins, p["weight"])
+        return y + p["bias"][None]
+    _record("locally_connected_1d", params, x, fwd)
+
+
+@case("spatial_within_channel_lrn")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 7, 7))
+    size, alpha, beta = 5, 1.0, 0.75
+
+    def fwd(p, x):
+        sq = x * x
+        summed = F.avg_pool2d(sq, size, stride=1, padding=size // 2,
+                              count_include_pad=True) * (size * size)
+        return x / (1.0 + alpha / (size * size) * summed) ** beta
+    _record("spatial_within_channel_lrn", {}, x, fwd)
+
+
+@case("upsampling_2d")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 4, 5))
+    _record("upsampling_2d", {}, x,
+            lambda p, x: F.interpolate(x, scale_factor=(2, 3),
+                                       mode="nearest"))
+
+
+@case("upsampling_3d")
+def _(rng):
+    x = rng.normal(0, 1, (2, 2, 3, 4, 4))
+    _record("upsampling_3d", {}, x,
+            lambda p, x: F.interpolate(x, scale_factor=(2, 2, 2),
+                                       mode="nearest"))
+
+
+@case("resize_bilinear_align")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 5, 5))
+    _record("resize_bilinear_align", {}, x,
+            lambda p, x: F.interpolate(x, size=(8, 9), mode="bilinear",
+                                       align_corners=True))
+
+
+@case("temporal_max_pooling")
+def _(rng):
+    x = rng.normal(0, 1, (2, 8, 4))  # (N, T, C)
+
+    def fwd(p, x):
+        return F.max_pool1d(x.transpose(1, 2), 2, 2).transpose(1, 2)
+    _record("temporal_max_pooling", {}, x, fwd)
+
+
+@case("temporal_convolution")
+def _(rng):
+    x = rng.normal(0, 1, (2, 9, 5))  # (N, T, C)
+    params = {"weight": rng.normal(0, 0.1, (6, 5, 3)),  # (O, C, kw)
+              "bias": rng.normal(0, 0.1, (6,))}
+
+    def fwd(p, x):
+        return F.conv1d(x.transpose(1, 2), p["weight"], p["bias"],
+                        stride=2).transpose(1, 2)
+    _record("temporal_convolution", params, x, fwd)
+
+
+def main(only=None):
+    rng = np.random.default_rng(20260729)
+    for name, fn in CASES.items():
+        if only and only not in name:
+            continue
+        fn(np.random.default_rng(abs(hash(name)) % (2**31)))
+    print(f"{len(CASES)} fixtures written to {DATA_DIR}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

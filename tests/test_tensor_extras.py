@@ -1,0 +1,210 @@
+"""Semantic tests for the table-op / distance / stochastic layer family
+(``bigdl_tpu/nn/tensor_extras.py``; reference ``DL/nn/MM.scala`` etc.)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _apply(mod, input, training=False, rng=None):
+    params, state = mod.init(KEY)
+    out, _ = mod.apply(params, state, input, training=training, rng=rng)
+    return out, params
+
+
+def test_mm_mv_dot():
+    a = jax.random.normal(KEY, (4, 3, 5))
+    b = jax.random.normal(jax.random.fold_in(KEY, 1), (4, 5, 2))
+    out, _ = _apply(nn.MM(), (a, b))
+    np.testing.assert_allclose(out, jnp.matmul(a, b), rtol=1e-6)
+    out, _ = _apply(nn.MM(trans_a=True), (jnp.swapaxes(a, -1, -2), b))
+    np.testing.assert_allclose(out, jnp.matmul(a, b), rtol=1e-6)
+
+    v = jax.random.normal(KEY, (4, 5))
+    out, _ = _apply(nn.MV(), (a, v))
+    np.testing.assert_allclose(out, jnp.einsum("nij,nj->ni", a, v), rtol=1e-5)
+
+    x = jax.random.normal(KEY, (6, 7))
+    y = jax.random.normal(jax.random.fold_in(KEY, 2), (6, 7))
+    out, _ = _apply(nn.DotProduct(), (x, y))
+    np.testing.assert_allclose(out, jnp.sum(x * y, -1), rtol=1e-5)
+
+
+def test_cross_product_order():
+    xs = [jnp.ones((2, 3)) * i for i in (1.0, 2.0, 3.0)]
+    out, _ = _apply(nn.CrossProduct(), xs)
+    # pairs (1,2),(1,3),(2,3) -> dot = 3*prod
+    np.testing.assert_allclose(out[0], [6.0, 9.0, 18.0])
+
+
+def test_distances():
+    x = jnp.array([[3.0, 0.0], [0.0, 4.0]])
+    y = jnp.zeros((2, 2))
+    out, _ = _apply(nn.PairwiseDistance(2), (x, y))
+    np.testing.assert_allclose(out, [3.0, 4.0], rtol=1e-6)
+
+    out, _ = _apply(nn.CosineDistance(), (x, x))
+    np.testing.assert_allclose(out, [1.0, 1.0], rtol=1e-5)
+
+    mod = nn.Euclidean(2, 3)
+    out, params = _apply(mod, x)
+    want = np.linalg.norm(np.asarray(x)[:, None] - np.asarray(
+        params["weight"])[None], axis=-1)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    mod = nn.Cosine(2, 3)
+    out, params = _apply(mod, x)
+    w = np.asarray(params["weight"])
+    want = (np.asarray(x) @ w.T) / (
+        np.linalg.norm(x, axis=-1, keepdims=True)
+        * np.linalg.norm(w, axis=-1))
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_bilinear():
+    mod = nn.Bilinear(3, 4, 2)
+    x1 = jax.random.normal(KEY, (5, 3))
+    x2 = jax.random.normal(jax.random.fold_in(KEY, 1), (5, 4))
+    out, params = _apply(mod, (x1, x2))
+    w = np.asarray(params["weight"])
+    want = np.einsum("ni,oij,nj->no", x1, w, x2) + np.asarray(params["bias"])
+    np.testing.assert_allclose(out, want, rtol=1e-4)
+
+
+def test_maxout_highway_grads():
+    mod = nn.Maxout(4, 3, pool=2)
+    x = jax.random.normal(KEY, (5, 4))
+    params, state = mod.init(KEY)
+    out, _ = mod.apply(params, state, x)
+    assert out.shape == (5, 3)
+    y = x @ params["weight"].T + params["bias"]
+    want = jnp.max(y.reshape(5, 2, 3), axis=1)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    hw = nn.Highway(4)
+    params, state = hw.init(KEY)
+    out, _ = hw.apply(params, state, x)
+    assert out.shape == x.shape
+    g = jax.grad(lambda p: jnp.sum(hw.apply(p, {}, x)[0]))(params)
+    assert all(jnp.all(jnp.isfinite(v)) for v in jax.tree_util.tree_leaves(g))
+
+
+def test_mixture_table():
+    g = jnp.array([[0.3, 0.7]])
+    e1, e2 = jnp.ones((1, 4)), 2 * jnp.ones((1, 4))
+    out, _ = _apply(nn.MixtureTable(), (g, (e1, e2)))
+    np.testing.assert_allclose(out, 1.7 * jnp.ones((1, 4)), rtol=1e-6)
+    # stacked-expert form
+    out2, _ = _apply(nn.MixtureTable(), (g, jnp.stack([e1, e2], 1)))
+    np.testing.assert_allclose(out2, out, rtol=1e-6)
+
+
+def test_table_utils():
+    x = jnp.arange(12.0).reshape(3, 4)
+    out, _ = _apply(nn.Reverse(1), x)
+    np.testing.assert_allclose(out, x[:, ::-1])
+
+    out, _ = _apply(nn.Tile(0, 2), x)
+    assert out.shape == (6, 4)
+
+    out, _ = _apply(nn.InferReshape((0, -1, 2), batch_mode=False), x)
+    assert out.shape == (3, 2, 2)
+
+    a, b = _apply(nn.BifurcateSplitTable(1), x)[0]
+    assert a.shape == b.shape == (3, 2)
+
+    out, _ = _apply(nn.NarrowTable(1, 2), (x, x + 1, x + 2))
+    assert len(out) == 2
+    np.testing.assert_allclose(out[0], x + 1)
+
+    out, _ = _apply(nn.CAveTable(), (x, x + 2))
+    np.testing.assert_allclose(out, x + 1)
+
+    out, _ = _apply(nn.MaskedSelect(), (x, x > 5))
+    np.testing.assert_allclose(out, jnp.arange(6.0, 12.0))
+
+
+def test_bottle_maptable():
+    inner = nn.Linear(4, 2)
+    mod = nn.Bottle(inner, 2)
+    x = jax.random.normal(KEY, (3, 5, 4))
+    params, state = mod.init(KEY)
+    out, _ = mod.apply(params, state, x)
+    assert out.shape == (3, 5, 2)
+    flat, _ = inner.apply(params, state, x.reshape(15, 4))
+    np.testing.assert_allclose(out, flat.reshape(3, 5, 2), rtol=1e-5)
+
+    mt = nn.MapTable(nn.Linear(4, 2))
+    params, state = mt.init(KEY)
+    outs, _ = mt.apply(params, state, (x[:, 0], x[:, 1]))
+    assert len(outs) == 2 and outs[0].shape == (3, 2)
+
+
+def test_gradient_reversal():
+    mod = nn.GradientReversal(the_lambda=2.0)
+    x = jnp.array([1.0, 2.0])
+    out, _ = _apply(mod, x)
+    np.testing.assert_allclose(out, x)
+    g = jax.grad(lambda z: jnp.sum(mod.apply({}, {}, z)[0]))(x)
+    np.testing.assert_allclose(g, -2.0 * jnp.ones(2))
+
+
+def test_stochastic_layers():
+    x = jnp.ones((256, 8))
+    rng = jax.random.PRNGKey(3)
+    out, _ = _apply(nn.GaussianDropout(0.5), x, training=True, rng=rng)
+    assert abs(float(jnp.mean(out)) - 1.0) < 0.15
+    out, _ = _apply(nn.GaussianDropout(0.5), x, training=False)
+    np.testing.assert_allclose(out, x)
+
+    out, _ = _apply(nn.GaussianNoise(0.1), x, training=True, rng=rng)
+    assert abs(float(jnp.std(out)) - 0.1) < 0.05
+
+    mean, lv = jnp.zeros((512, 4)), jnp.zeros((512, 4))
+    out, _ = _apply(nn.GaussianSampler(), (mean, lv), rng=rng)
+    assert abs(float(jnp.std(out)) - 1.0) < 0.1
+
+
+def test_penalty_layers():
+    x = jnp.array([[1.0, -2.0], [3.0, -4.0]])
+    mod = nn.L1Penalty(0.5)
+    out, _ = _apply(mod, x)
+    np.testing.assert_allclose(out, x)
+    np.testing.assert_allclose(float(mod.penalty(x)), 5.0)
+
+    ar = nn.ActivityRegularization(l1=1.0, l2=1.0)
+    np.testing.assert_allclose(float(ar.penalty(x)), 10.0 + 30.0)
+
+    p = jnp.array([[0.5, 0.5]])
+    ne = nn.NegativeEntropyPenalty(1.0)
+    np.testing.assert_allclose(float(ne.penalty(p)), -0.6931, atol=1e-3)
+
+
+def test_misc_small():
+    x = jnp.array([-1.0, 0.5, 2.0])
+    out, _ = _apply(nn.Negative(), x)
+    np.testing.assert_allclose(out, -x)
+    out, _ = _apply(nn.BinaryThreshold(0.6), x)
+    np.testing.assert_allclose(out, [0.0, 0.0, 1.0])
+    out, _ = _apply(nn.Add(3), x)
+    np.testing.assert_allclose(out, x)  # zero-init bias
+    out, _ = _apply(nn.Mul(), x)
+    np.testing.assert_allclose(out, x)  # one-init gain
+
+
+def test_new_activations():
+    x = jnp.array([-2.0, -0.3, 0.0, 0.3, 2.0])
+    out, _ = _apply(nn.HardShrink(0.5), x)
+    np.testing.assert_allclose(out, [-2.0, 0.0, 0.0, 0.0, 2.0])
+    out, _ = _apply(nn.SoftShrink(0.5), x)
+    np.testing.assert_allclose(out, [-1.5, 0.0, 0.0, 0.0, 1.5])
+    out, _ = _apply(nn.LogSigmoid(), x)
+    np.testing.assert_allclose(out, jax.nn.log_sigmoid(x), rtol=1e-6)
+    out, _ = _apply(nn.SoftMin(), x)
+    np.testing.assert_allclose(out, jax.nn.softmax(-x), rtol=1e-6)
+    out, _ = _apply(nn.TanhShrink(), x)
+    np.testing.assert_allclose(out, x - jnp.tanh(x), rtol=1e-6)
