@@ -1,0 +1,139 @@
+"""DataFrame-free Estimator/Transformer facade.
+
+Reference: ``DL/dlframes/DLEstimator.scala`` + the Spark-ML thin aliases
+``org/apache/spark/ml/DLEstimator.scala:49`` / ``DLClassifier.scala:83`` —
+an ``Estimator.fit(DataFrame) -> Model`` / ``Model.transform(DataFrame)``
+pair wrapping Optimizer and Predictor.
+
+TPU redesign (SURVEY §7 stage 7): Spark DataFrames don't exist here, so
+``fit``/``transform`` operate on array-likes (or ``AbstractDataSet``s) —
+the scikit-learn-shaped contract the Spark-ML API itself imitates.  The
+parameter surface (feature/label sizes, batch size, epochs, optim method,
+validation) mirrors ``DLEstimator``'s params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.predictor import Predictor
+
+
+class NNModel:
+    """Fitted transformer (reference ``DLModel``/``DLTransformerBase``)."""
+
+    def __init__(self, model: Module, params=None, state=None,
+                 batch_size: int = 128):
+        self.model = model
+        self.params = params if params is not None else model._params
+        self.state = state if state is not None else model._state
+        self.batch_size = batch_size
+        self._predictor = Predictor(model, params=self.params,
+                                    state=self.state, batch_size=batch_size)
+
+    def transform(self, features) -> np.ndarray:
+        """Batched forward over features (reference ``DLModel.transform``)."""
+        return self._predictor.predict(np.asarray(features))
+
+    def set_batch_size(self, n: int) -> "NNModel":
+        self.batch_size = n
+        self._predictor.batch_size = n
+        return self
+
+
+class NNClassifierModel(NNModel):
+    """Classifier variant: transform returns class ids
+    (reference ``DLClassifierModel`` — argmax + 1-based labels; here
+    0-based like the rest of the TPU build)."""
+
+    def transform(self, features) -> np.ndarray:
+        return np.argmax(super().transform(features), axis=-1)
+
+
+class NNEstimator:
+    """Unfitted estimator (reference ``DLEstimator.scala``)."""
+
+    model_cls = NNModel
+
+    def __init__(self, model: Module, criterion: nn.Criterion,
+                 batch_size: int = 32, max_epoch: int = 10,
+                 optim_method: Optional[optim.OptimMethod] = None,
+                 distributed: bool = False):
+        self.model = model
+        self.criterion = criterion
+        self.batch_size = batch_size
+        self.max_epoch = max_epoch
+        self.optim_method = optim_method or optim.SGD(learning_rate=0.01)
+        self.distributed = distributed
+        self.validation: Optional[tuple] = None
+        self.end_when: Optional[optim.Trigger] = None
+
+    # ---------------------------------------------------------- builders
+    def set_batch_size(self, n: int) -> "NNEstimator":
+        self.batch_size = n
+        return self
+
+    def set_max_epoch(self, n: int) -> "NNEstimator":
+        self.max_epoch = n
+        return self
+
+    def set_optim_method(self, m: optim.OptimMethod) -> "NNEstimator":
+        self.optim_method = m
+        return self
+
+    def set_end_when(self, trigger: optim.Trigger) -> "NNEstimator":
+        self.end_when = trigger
+        return self
+
+    def set_validation(self, trigger: optim.Trigger, features, labels,
+                       methods: Sequence[optim.ValidationMethod],
+                       batch_size: Optional[int] = None) -> "NNEstimator":
+        self.validation = (trigger, features, labels,
+                           list(methods), batch_size or self.batch_size)
+        return self
+
+    # --------------------------------------------------------------- fit
+    def _to_dataset(self, features, labels, batch_size,
+                    drop_remainder=True) -> AbstractDataSet:
+        if isinstance(features, AbstractDataSet):
+            return features
+        f = np.asarray(features)
+        l = None if labels is None else np.asarray(labels)
+        samples = [Sample(f[i], None if l is None else l[i])
+                   for i in range(len(f))]
+        return DataSet.array(samples) >> SampleToMiniBatch(
+            batch_size, drop_remainder=drop_remainder)
+
+    def fit(self, features, labels=None) -> NNModel:
+        """Train and return the fitted ``NNModel``
+        (reference ``DLEstimator.fit`` → internal Optimizer)."""
+        train_set = self._to_dataset(features, labels, self.batch_size)
+        cls = (optim.DistriOptimizer if self.distributed
+               else optim.LocalOptimizer)
+        optimizer = (cls(self.model, train_set, self.criterion)
+                     .set_optim_method(self.optim_method)
+                     .set_end_when(self.end_when
+                                   or optim.max_epoch(self.max_epoch)))
+        if self.validation is not None:
+            trig, vf, vl, methods, vbs = self.validation
+            val_set = self._to_dataset(vf, vl, vbs, drop_remainder=False)
+            optimizer.set_validation(trig, val_set, methods)
+        optimizer.optimize()
+        return self.model_cls(self.model, batch_size=self.batch_size)
+
+
+class NNClassifier(NNEstimator):
+    """Classification estimator (reference ``DLClassifier.scala``)."""
+
+    model_cls = NNClassifierModel
+
+    def __init__(self, model: Module,
+                 criterion: Optional[nn.Criterion] = None, **kw):
+        super().__init__(model, criterion or nn.ClassNLLCriterion(), **kw)

@@ -1,0 +1,30 @@
+"""bigdl_tpu.keras — Keras-1.2-style sugar over the core module system.
+
+Reference: ``DL/nn/keras/`` (71 files, 6,229 LoC) — ``KerasLayer`` wrappers
+with shape inference plus a ``Sequential``/``Model`` topology exposing
+``compile/fit/evaluate/predict`` (``DL/nn/keras/Topology.scala:55,89,116``).
+
+TPU redesign: the reference re-implements shape inference per layer
+(``InferShape``); here a single ``jax.eval_shape`` trace over the wrapped
+core module replaces all of it — the XLA abstract interpreter IS the shape
+inference engine, so each wrapper only declares how to *build* its core
+module once the input shape is known.
+"""
+
+from bigdl_tpu.keras.layers import (
+    KerasLayer, Dense, Activation, Dropout, Flatten, Reshape,
+    Convolution1D, Convolution2D, MaxPooling2D, AveragePooling2D,
+    GlobalAveragePooling2D, GlobalMaxPooling2D, ZeroPadding2D,
+    BatchNormalization, Embedding, SimpleRNN, LSTM, GRU, Bidirectional,
+    TimeDistributed, InputLayer,
+)
+from bigdl_tpu.keras.topology import Sequential, Model
+
+__all__ = [
+    "KerasLayer", "Dense", "Activation", "Dropout", "Flatten", "Reshape",
+    "Convolution1D", "Convolution2D", "MaxPooling2D", "AveragePooling2D",
+    "GlobalAveragePooling2D", "GlobalMaxPooling2D", "ZeroPadding2D",
+    "BatchNormalization", "Embedding", "SimpleRNN", "LSTM", "GRU",
+    "Bidirectional", "TimeDistributed", "InputLayer",
+    "Sequential", "Model",
+]

@@ -1,0 +1,371 @@
+"""Keras-1.2-named layer wrappers with deferred build + shape inference.
+
+Reference: ``DL/nn/keras/`` wraps every core layer in a ``KerasLayer`` that
+adds Keras names and an ``InferShape`` implementation per layer
+(``DL/nn/keras/KerasLayer.scala``, ``Dense.scala``, ``Convolution2D.scala``).
+
+TPU redesign: a ``KerasLayer`` here is a *deferred* core module — it holds
+Keras-style hyper-parameters and builds the underlying ``bigdl_tpu.nn``
+module only once the input shape is known (at ``Sequential.build`` /
+``compile`` time).  Output-shape inference is NOT hand-written per layer:
+``jax.eval_shape`` abstractly traces the built module, so every wrapper
+gets exact shape inference for free from XLA's abstract interpreter.
+
+Keras conventions honored (Keras 1.2.2, the version the reference imports):
+- images are channels-first here (``dim_ordering="th"``) to match the
+  reference's default NCHW zoo; pass ``dim_ordering="tf"`` for NHWC (the
+  TPU-preferred layout).
+- ``input_shape`` excludes the batch dimension.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module
+
+_ACTIVATIONS = {
+    "relu": nn.ReLU, "tanh": nn.Tanh, "sigmoid": nn.Sigmoid,
+    "softmax": nn.SoftMax, "log_softmax": nn.LogSoftMax,
+    "softplus": nn.SoftPlus, "softsign": nn.SoftSign, "linear": None,
+    "hard_sigmoid": nn.HardSigmoid, "gelu": nn.GELU, "silu": nn.SiLU,
+    "elu": nn.ELU,
+}
+
+
+def activation_module(name: Optional[str]) -> Optional[Module]:
+    if name is None or name == "linear":
+        return None
+    try:
+        cls = _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}") from None
+    return cls() if cls is not None else None
+
+
+def infer_output_shape(module: Module, input_shape: Tuple[int, ...],
+                       batch: int = 2) -> Tuple[int, ...]:
+    """Output shape (sans batch) of ``module`` on ``(batch, *input_shape)``
+    inputs, via abstract tracing — no FLOPs, no device memory."""
+    x = jax.ShapeDtypeStruct((batch,) + tuple(input_shape), jnp.float32)
+
+    def fwd(x):
+        params, state = module.init(jax.random.PRNGKey(0))
+        out, _ = module.apply(params, state, x, training=False)
+        return out
+
+    out = jax.eval_shape(fwd, x)
+    return tuple(out.shape[1:])
+
+
+class KerasLayer:
+    """Deferred layer: Keras hyper-params now, core module at build time."""
+
+    def __init__(self, input_shape: Optional[Sequence[int]] = None,
+                 name: Optional[str] = None):
+        self.input_shape = None if input_shape is None else tuple(input_shape)
+        self.name = name or type(self).__name__
+
+    def build(self, input_shape: Tuple[int, ...]) -> Module:
+        """Return the core module for inputs of ``input_shape`` (no batch)."""
+        raise NotImplementedError(type(self).__name__)
+
+    def output_shape(self, input_shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        return infer_output_shape(self.build(input_shape), input_shape)
+
+
+class InputLayer(KerasLayer):
+    def __init__(self, input_shape: Sequence[int], name=None):
+        super().__init__(input_shape=input_shape, name=name)
+
+    def build(self, input_shape):
+        return nn.Identity()
+
+
+class _WithActivation(KerasLayer):
+    """Helper: wrap a core module with an optional trailing activation."""
+
+    def _maybe_activate(self, core: Module) -> Module:
+        act = activation_module(getattr(self, "activation", None))
+        if act is None:
+            return core
+        return nn.Sequential(core, act)
+
+
+class Dense(_WithActivation):
+    """Keras ``Dense`` → ``nn.Linear`` (reference ``DL/nn/keras/Dense.scala``)."""
+
+    def __init__(self, output_dim: int, activation: Optional[str] = None,
+                 bias: bool = True, input_shape=None, input_dim=None,
+                 name=None):
+        if input_dim is not None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.bias = bias
+
+    def build(self, input_shape):
+        return self._maybe_activate(
+            nn.Linear(int(input_shape[-1]), self.output_dim,
+                      with_bias=self.bias))
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation: str, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.activation = activation
+
+    def build(self, input_shape):
+        return activation_module(self.activation) or nn.Identity()
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = p
+
+    def build(self, input_shape):
+        return nn.Dropout(self.p)
+
+
+class Flatten(KerasLayer):
+    def build(self, input_shape):
+        return nn.Flatten()
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape: Sequence[int], input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.target_shape = tuple(target_shape)
+
+    def build(self, input_shape):
+        return nn.Reshape(self.target_shape)
+
+
+class Convolution2D(_WithActivation):
+    """Keras ``Convolution2D`` → ``nn.SpatialConvolution``
+    (reference ``DL/nn/keras/Convolution2D.scala``)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation: Optional[str] = None,
+                 border_mode: str = "valid",
+                 subsample: Tuple[int, int] = (1, 1),
+                 dim_ordering: str = "th", bias: bool = True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = subsample
+        self.dim_ordering = dim_ordering
+        self.bias = bias
+
+    def build(self, input_shape):
+        ch_axis = 0 if self.dim_ordering == "th" else -1
+        in_ch = int(input_shape[ch_axis])
+        # Keras "same" = ceil(in/stride) output with asymmetric padding —
+        # exactly XLA's SAME mode, which the core conv selects on pad=-1
+        pad = -1 if self.border_mode == "same" else 0
+        return self._maybe_activate(nn.SpatialConvolution(
+            in_ch, self.nb_filter, self.nb_col, self.nb_row,
+            stride_w=self.subsample[1], stride_h=self.subsample[0],
+            pad_w=pad, pad_h=pad, with_bias=self.bias,
+            format="NCHW" if self.dim_ordering == "th" else "NHWC"))
+
+
+class Convolution1D(_WithActivation):
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation: Optional[str] = None, subsample_length: int = 1,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+
+    def build(self, input_shape):
+        return self._maybe_activate(nn.TemporalConvolution(
+            int(input_shape[-1]), self.nb_filter, self.filter_length,
+            stride=self.subsample_length))
+
+
+class _Pooling2D(KerasLayer):
+    core_cls: Any = None
+
+    def __init__(self, pool_size: Tuple[int, int] = (2, 2),
+                 strides: Optional[Tuple[int, int]] = None,
+                 border_mode: str = "valid", dim_ordering: str = "th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+        self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
+
+    def build(self, input_shape):
+        fmt = "NCHW" if self.dim_ordering == "th" else "NHWC"
+        if self.border_mode == "same":
+            # Keras/TF "same" pooling: ceil(in/stride) output, asymmetric
+            # padding, padded cells excluded — lax.reduce_window SAME mode
+            return self._same_pool(fmt)
+        return self.core_cls(
+            self.pool_size[1], self.pool_size[0],
+            self.strides[1], self.strides[0], 0, 0, format=fmt)
+
+    def _same_pool(self, fmt: str) -> Module:
+        ph, pw = self.pool_size
+        sh, sw = self.strides
+        if fmt == "NCHW":
+            dims, strides = (1, 1, ph, pw), (1, 1, sh, sw)
+        else:
+            dims, strides = (1, ph, pw, 1), (1, sh, sw, 1)
+        is_max = self.core_cls is nn.SpatialMaxPooling
+
+        def pool(x):
+            from jax import lax
+            if is_max:
+                return lax.reduce_window(x, -jnp.inf, lax.max, dims,
+                                         strides, "SAME")
+            total = lax.reduce_window(x, 0.0, lax.add, dims, strides, "SAME")
+            count = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims,
+                                      strides, "SAME")
+            return total / count
+
+        return nn.Lambda(pool)
+
+
+class MaxPooling2D(_Pooling2D):
+    core_cls = nn.SpatialMaxPooling
+
+
+class AveragePooling2D(_Pooling2D):
+    core_cls = nn.SpatialAveragePooling
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def __init__(self, dim_ordering: str = "th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim_ordering = dim_ordering
+
+    def build(self, input_shape):
+        axes = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        return nn.Lambda(lambda x: jnp.mean(x, axis=axes))
+
+
+class GlobalMaxPooling2D(GlobalAveragePooling2D):
+    def build(self, input_shape):
+        axes = (2, 3) if self.dim_ordering == "th" else (1, 2)
+        return nn.Lambda(lambda x: jnp.max(x, axis=axes))
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding: Tuple[int, int] = (1, 1),
+                 dim_ordering: str = "th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.padding = padding
+        self.dim_ordering = dim_ordering
+
+    def build(self, input_shape):
+        ph, pw = self.padding
+        if self.dim_ordering == "th":
+            pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        else:
+            pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        return nn.Lambda(lambda x: jnp.pad(x, pads))
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 dim_ordering: str = "th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+        self.dim_ordering = dim_ordering
+
+    def build(self, input_shape):
+        if len(input_shape) == 3:  # image: per-channel BN
+            n = input_shape[0 if self.dim_ordering == "th" else -1]
+            return nn.SpatialBatchNormalization(
+                int(n), eps=self.epsilon, momentum=1.0 - self.momentum,
+                format="NCHW" if self.dim_ordering == "th" else "NHWC")
+        return nn.BatchNormalization(int(input_shape[-1]), eps=self.epsilon,
+                                     momentum=1.0 - self.momentum)
+
+
+class Embedding(KerasLayer):
+    def __init__(self, input_dim: int, output_dim: int, input_shape=None,
+                 input_length=None, name=None):
+        if input_length is not None:
+            input_shape = (input_length,)
+        super().__init__(input_shape=input_shape, name=name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+
+    def build(self, input_shape):
+        return nn.LookupTable(self.input_dim, self.output_dim)
+
+
+class _Recurrent(KerasLayer):
+    cell_cls: Any = None
+
+    def __init__(self, output_dim: int, return_sequences: bool = False,
+                 go_backwards: bool = False, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = output_dim
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def build(self, input_shape):
+        cell = self.cell_cls(int(input_shape[-1]), self.output_dim)
+        rec = nn.Recurrent(cell, reverse=self.go_backwards)
+        if self.return_sequences:
+            return rec
+        return nn.Sequential(rec, nn.Lambda(lambda x: x[:, -1]))
+
+
+class SimpleRNN(_Recurrent):
+    cell_cls = nn.RnnCell
+
+
+class LSTM(_Recurrent):
+    cell_cls = nn.LSTM
+
+
+class GRU(_Recurrent):
+    cell_cls = nn.GRU
+
+
+class Bidirectional(KerasLayer):
+    def __init__(self, layer: _Recurrent, merge_mode: str = "concat",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape or layer.input_shape,
+                         name=name)
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def build(self, input_shape):
+        fwd = self.layer.cell_cls(int(input_shape[-1]),
+                                  self.layer.output_dim)
+        bwd = self.layer.cell_cls(int(input_shape[-1]),
+                                  self.layer.output_dim)
+        rec = nn.BiRecurrent(fwd, bwd, merge=self.merge_mode)
+        if self.layer.return_sequences:
+            return rec
+        return nn.Sequential(rec, nn.Lambda(lambda x: x[:, -1]))
+
+
+class TimeDistributed(KerasLayer):
+    def __init__(self, layer: KerasLayer, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape or layer.input_shape,
+                         name=name)
+        self.layer = layer
+
+    def build(self, input_shape):
+        inner = self.layer.build(tuple(input_shape[1:]))
+        return nn.TimeDistributed(inner)

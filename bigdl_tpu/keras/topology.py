@@ -1,0 +1,210 @@
+"""Keras-style ``Sequential``/``Model`` topology with compile/fit/evaluate/
+predict.
+
+Reference: ``DL/nn/keras/Topology.scala`` — ``compile:55`` resolves
+string-named optimizer/loss/metrics, ``fit:89`` wraps the Optimizer,
+``evaluate:116``/``predict`` wrap Evaluator/Predictor.  The pyspark mirror
+is ``pyspark/bigdl/keras/backend.py`` (``KerasModelWrapper``).
+
+Here the topology compiles down to the core functional stack: building a
+``Sequential`` walks the deferred ``KerasLayer``s forward, inferring each
+input shape with ``jax.eval_shape`` (see ``keras/layers.py``), and ``fit``
+drives ``LocalOptimizer``/``DistriOptimizer`` on an in-memory ``DataSet``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.keras.layers import KerasLayer
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.predictor import Predictor
+
+_OPTIMIZERS = {
+    "sgd": lambda: optim.SGD(learning_rate=0.01),
+    "adam": lambda: optim.Adam(),
+    "adagrad": lambda: optim.Adagrad(),
+    "adadelta": lambda: optim.Adadelta(),
+    "adamax": lambda: optim.Adamax(),
+    "rmsprop": lambda: optim.RMSprop(),
+}
+
+_LOSSES = {
+    # Keras contract: probability inputs (pair with activation="softmax"),
+    # one-hot OR integer targets (CategoricalCrossEntropy handles both)
+    "categorical_crossentropy": nn.CategoricalCrossEntropy,
+    "sparse_categorical_crossentropy": nn.CategoricalCrossEntropy,
+    "mse": nn.MSECriterion, "mean_squared_error": nn.MSECriterion,
+    "mae": nn.AbsCriterion, "mean_absolute_error": nn.AbsCriterion,
+    "binary_crossentropy": nn.BCECriterion,
+    "hinge": nn.MarginCriterion,
+    "kld": nn.DistKLDivCriterion,
+}
+
+_METRICS = {
+    "accuracy": optim.Top1Accuracy, "acc": optim.Top1Accuracy,
+    "top5": optim.Top5Accuracy,
+    "mae": optim.MAE,
+    "loss": optim.Loss,
+}
+
+
+def _resolve(table, value, kind):
+    if isinstance(value, str):
+        try:
+            return table[value.lower()]()
+        except KeyError:
+            raise ValueError(f"unknown {kind} {value!r}") from None
+    return value
+
+
+class _Topology:
+    """Shared compile/fit/evaluate/predict machinery."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or type(self).__name__
+        self.optim_method = None
+        self.criterion = None
+        self.metrics: Sequence = ()
+        self._params = None
+        self._mstate = None
+
+    # ------------------------------------------------------------ compile
+    def compile(self, optimizer: Union[str, Any], loss: Union[str, Any],
+                metrics: Optional[Sequence] = None) -> "_Topology":
+        """Resolve optimizer/loss/metrics (reference ``Topology.scala:55``)."""
+        self.optim_method = _resolve(_OPTIMIZERS, optimizer, "optimizer")
+        self.criterion = _resolve(_LOSSES, loss, "loss")
+        if isinstance(self.criterion, type):
+            self.criterion = self.criterion()
+        self.metrics = [_resolve(_METRICS, m, "metric")
+                        for m in (metrics or [])]
+        return self
+
+    # ---------------------------------------------------------- core hook
+    def core_module(self) -> Module:
+        raise NotImplementedError
+
+    @staticmethod
+    def _to_dataset(x, y, batch_size, drop_remainder=True):
+        x = np.asarray(x)
+        y = None if y is None else np.asarray(y)
+        samples = [Sample(x[i], None if y is None else y[i])
+                   for i in range(len(x))]
+        return DataSet.array(samples) >> SampleToMiniBatch(
+            batch_size, drop_remainder=drop_remainder)
+
+    # ---------------------------------------------------------------- fit
+    def fit(self, x, y, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data: Optional[Tuple] = None,
+            distributed: bool = False) -> "_Topology":
+        """Train (reference ``Topology.scala:89``; pyspark
+        ``keras/backend.py`` fit)."""
+        if self.criterion is None:
+            raise RuntimeError("call compile(...) before fit(...)")
+        model = self.core_module()
+        train_set = self._to_dataset(x, y, batch_size)
+        cls = optim.DistriOptimizer if distributed else optim.LocalOptimizer
+        optimizer = (cls(model, train_set, self.criterion)
+                     .set_optim_method(self.optim_method)
+                     .set_end_when(optim.max_epoch(nb_epoch)))
+        if validation_data is not None:
+            vx, vy = validation_data
+            val_set = self._to_dataset(vx, vy, batch_size,
+                                       drop_remainder=False)
+            optimizer.set_validation(
+                optim.every_epoch(), val_set,
+                self.metrics or [optim.Loss(self.criterion)])
+        optimizer.optimize()
+        self._params = model._params
+        self._mstate = model._state
+        return self
+
+    # ----------------------------------------------------------- evaluate
+    def evaluate(self, x, y, batch_size: int = 32) -> dict:
+        """Metric name → value (reference ``Topology.scala:116``)."""
+        model = self.core_module()
+        val_set = self._to_dataset(x, y, batch_size, drop_remainder=False)
+        from bigdl_tpu.optim.predictor import Evaluator
+        ev = Evaluator(model, params=self._params, state=self._mstate)
+        methods = self.metrics or [optim.Loss(self.criterion)]
+        results = ev.evaluate(val_set, methods)
+        return {name: r.result for name, r in results.items()}
+
+    # ------------------------------------------------------------ predict
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        model = self.core_module()
+        pred = Predictor(model, params=self._params, state=self._mstate,
+                         batch_size=batch_size)
+        return pred.predict(np.asarray(x))
+
+    def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
+        return np.argmax(self.predict(x, batch_size), axis=-1)
+
+
+class Sequential(_Topology):
+    """Keras Sequential: stack of deferred layers, built via eval_shape."""
+
+    def __init__(self, layers: Optional[Sequence[KerasLayer]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.layers: list = []
+        self._core: Optional[Module] = None
+        for l in layers or []:
+            self.add(l)
+
+    def add(self, layer: KerasLayer) -> "Sequential":
+        if not self.layers and layer.input_shape is None:
+            raise ValueError(
+                "first layer needs input_shape= (Keras 1.2 convention)")
+        self.layers.append(layer)
+        self._core = None  # invalidate built core
+        return self
+
+    def build(self) -> Module:
+        shape = self.layers[0].input_shape
+        core = nn.Sequential()
+        for layer in self.layers:
+            if layer.input_shape is not None:
+                shape = layer.input_shape
+            mod = layer.build(shape)
+            from bigdl_tpu.keras.layers import infer_output_shape
+            shape = infer_output_shape(mod, shape)
+            core.add(mod)
+        self._core = core
+        return core
+
+    def core_module(self) -> Module:
+        if self._core is None:
+            self.build()
+        if self._params is not None:
+            self._core._params = self._params
+            self._core._state = self._mstate
+        return self._core
+
+    @property
+    def output_shape(self) -> Tuple[int, ...]:
+        shape = self.layers[0].input_shape
+        for layer in self.layers:
+            shape = layer.output_shape(shape)
+        return (None,) + tuple(shape)
+
+
+class Model(_Topology):
+    """Keras functional ``Model``: wraps an already-built core module or
+    ``nn.Graph`` (reference ``Model`` in ``Topology.scala``)."""
+
+    def __init__(self, module: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self._core = module
+
+    def core_module(self) -> Module:
+        if self._params is not None:
+            self._core._params = self._params
+            self._core._state = self._mstate
+        return self._core

@@ -41,6 +41,12 @@ from bigdl_tpu.nn.criterion import (
     DiceCoefficientCriterion, MultiLabelSoftMarginCriterion, MultiCriterion,
     ParallelCriterion, TimeDistributedCriterion, PGCriterion,
     MultiLabelMarginCriterion, SoftmaxWithCriterion,
+    CosineDistanceCriterion, CosineProximityCriterion, DotProductCriterion,
+    KullbackLeiblerDivergenceCriterion, L1HingeEmbeddingCriterion,
+    MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion,
+    MultiMarginCriterion, PoissonCriterion, ClassSimplexCriterion,
+    SmoothL1CriterionWithWeights, TimeDistributedMaskCriterion,
+    TransformerCriterion, CategoricalCrossEntropy,
 )
 from bigdl_tpu.nn.graph import Graph, Input, Node
 from bigdl_tpu.nn.recurrent import (

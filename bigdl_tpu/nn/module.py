@@ -198,9 +198,11 @@ class Container(Module):
     pytree structure is stable under jit and independent of layer names
     (names may repeat)."""
 
-    def __init__(self, name: Optional[str] = None):
+    def __init__(self, *modules: Module, name: Optional[str] = None):
         super().__init__(name)
         self.modules: list[Module] = []
+        for m in modules:
+            self.add(m)
 
     def add(self, module: Module) -> "Container":
         self.modules.append(module)
@@ -281,7 +283,7 @@ class Concat(Container):
     here dims are 0-based with batch at 0, so channel concat is dim=1)."""
 
     def __init__(self, dim: int = 1, name: Optional[str] = None):
-        super().__init__(name)
+        super().__init__(name=name)
         self.dim = dim
 
     def apply(self, params, state, input, *, training=False, rng=None):

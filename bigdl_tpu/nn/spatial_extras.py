@@ -471,8 +471,8 @@ class Cropping2D(Module):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         h, w = input.shape[2], input.shape[3]
-        return input[:, :, self.hc[0]:h - self.hc[1] or None,
-                     self.wc[0]:w - self.wc[1] or None], state
+        return input[:, :, self.hc[0]:h - self.hc[1],
+                     self.wc[0]:w - self.wc[1]], state
 
 
 class Cropping3D(Module):
@@ -486,8 +486,7 @@ class Cropping3D(Module):
     def apply(self, params, state, input, *, training=False, rng=None):
         d, h, w = input.shape[2:]
         (d0, d1), (h0, h1), (w0, w1) = self.crops
-        return input[:, :, d0:d - d1 or None, h0:h - h1 or None,
-                     w0:w - w1 or None], state
+        return input[:, :, d0:d - d1, h0:h - h1, w0:w - w1], state
 
 
 class TemporalMaxPooling(Module):

@@ -16,8 +16,10 @@ import logging
 import os
 import sys
 
-# allow running straight from a repo checkout without installation
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+try:
+    import bigdl_tpu  # noqa: F401  (installed via `pip install -e .`)
+except ImportError:  # running straight from a repo checkout
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 
 def main():

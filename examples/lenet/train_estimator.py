@@ -1,0 +1,20 @@
+"""LeNet-5 via the Estimator facade (reference ``DL/dlframes/DLClassifier``)."""
+try:
+    import bigdl_tpu  # noqa: F401
+except ImportError:
+    import os, sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from bigdl_tpu import optim
+from bigdl_tpu.dataset import mnist
+from bigdl_tpu.estimator import NNClassifier
+from bigdl_tpu.models.lenet import lenet5
+
+x, y = mnist.synthetic_mnist(2048)
+x = ((x.reshape(-1, 1, 28, 28).astype("float32") / 255.0)
+     - mnist.TRAIN_MEAN) / mnist.TRAIN_STD
+clf = NNClassifier(lenet5(class_num=10), batch_size=128, max_epoch=2,
+                   optim_method=optim.SGD(learning_rate=0.05, momentum=0.9))
+fitted = clf.fit(x, y)
+acc = (fitted.transform(x) == y).mean()
+print(f"train accuracy: {acc:.4f}")

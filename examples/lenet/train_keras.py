@@ -1,0 +1,22 @@
+"""LeNet-5 in the Keras-style API (reference ``DL/example/keras/``)."""
+try:
+    import bigdl_tpu  # noqa: F401
+except ImportError:
+    import os, sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from bigdl_tpu.dataset import mnist
+from bigdl_tpu.keras import (Convolution2D, Dense, Flatten, MaxPooling2D,
+                             Sequential)
+
+x, y = mnist.synthetic_mnist(2048)
+x = ((x.reshape(-1, 1, 28, 28).astype("float32") / 255.0)
+     - mnist.TRAIN_MEAN) / mnist.TRAIN_STD
+model = Sequential([
+    Convolution2D(6, 5, 5, activation="tanh", input_shape=(1, 28, 28)),
+    MaxPooling2D(), Convolution2D(12, 5, 5, activation="tanh"),
+    MaxPooling2D(), Flatten(), Dense(100, activation="tanh"),
+    Dense(10, activation="softmax")])
+model.compile("sgd", "categorical_crossentropy", metrics=["accuracy"])
+model.fit(x, y, batch_size=128, nb_epoch=2, validation_data=(x, y))
+print("val:", model.evaluate(x, y))

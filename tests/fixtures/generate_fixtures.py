@@ -225,11 +225,13 @@ def _(rng):
 
 
 def main(only=None):
-    rng = np.random.default_rng(20260729)
+    import zlib
     for name, fn in CASES.items():
         if only and only not in name:
             continue
-        fn(np.random.default_rng(abs(hash(name)) % (2**31)))
+        # crc32 is stable across processes/machines (Python's str hash is
+        # salted per process), so regeneration is byte-reproducible
+        fn(np.random.default_rng(zlib.crc32(name.encode()) % (2**31)))
     print(f"{len(CASES)} fixtures written to {DATA_DIR}")
 
 

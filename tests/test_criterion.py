@@ -111,3 +111,130 @@ def test_multilabel_margin_class_zero_with_padding():
     t = jnp.array([[0, -1, -1]])
     loss = nn.MultiLabelMarginCriterion().forward(x, t)
     np.testing.assert_allclose(loss, 0.0)
+
+
+# ----------------------------------------------------------- round-2 breadth
+
+
+def test_cosine_distance_criterion():
+    x = jnp.array([[1.0, 0.0], [0.0, 2.0]])
+    # identical directions -> 0; orthogonal -> 1
+    np.testing.assert_allclose(
+        nn.CosineDistanceCriterion().forward(x, x), 0.0, atol=1e-6)
+    y = jnp.array([[0.0, 1.0], [2.0, 0.0]])
+    np.testing.assert_allclose(
+        nn.CosineDistanceCriterion().forward(x, y), 1.0, atol=1e-6)
+
+
+def test_cosine_proximity_matches_torch():
+    import torch
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype(np.float32)
+    y = rng.randn(4, 6).astype(np.float32)
+    ours = nn.CosineProximityCriterion().forward(jnp.asarray(x),
+                                                 jnp.asarray(y))
+    ref = -torch.nn.functional.cosine_similarity(
+        torch.tensor(x), torch.tensor(y)).mean().item()
+    np.testing.assert_allclose(float(ours), ref, rtol=1e-5)
+
+
+def test_dot_product_criterion_grad_is_target():
+    x = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+    t = jnp.array([[0.5, 0.5], [1.0, -1.0]])
+    c = nn.DotProductCriterion()
+    np.testing.assert_allclose(c.forward(x, t), float(np.sum(x * t)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(c.backward(x, t), t, rtol=1e-6)
+
+
+def test_kld_probability_form():
+    p = jnp.array([[0.5, 0.5]])
+    q = jnp.array([[0.25, 0.75]])
+    # KL(target||input): target=p, input=q
+    expected = float(np.sum(p * np.log(p / q)))
+    np.testing.assert_allclose(
+        nn.KullbackLeiblerDivergenceCriterion().forward(q, p), expected,
+        rtol=1e-5)
+
+
+def test_l1_hinge_embedding():
+    x1 = jnp.array([[1.0, 1.0]])
+    x2 = jnp.array([[0.0, 0.0]])
+    c = nn.L1HingeEmbeddingCriterion(margin=3.0)
+    np.testing.assert_allclose(c.forward((x1, x2), jnp.array([1])), 2.0)
+    np.testing.assert_allclose(c.forward((x1, x2), jnp.array([-1])), 1.0)
+
+
+def test_mape_msle_poisson():
+    t = jnp.array([[2.0, 4.0]])
+    x = jnp.array([[1.0, 5.0]])
+    np.testing.assert_allclose(
+        nn.MeanAbsolutePercentageCriterion().forward(x, t),
+        100.0 * (0.5 + 0.25) / 2, rtol=1e-5)
+    np.testing.assert_allclose(
+        nn.MeanSquaredLogarithmicCriterion().forward(x, t),
+        np.mean((np.log([2.0, 6.0]) - np.log([3.0, 5.0])) ** 2), rtol=1e-5)
+    np.testing.assert_allclose(
+        nn.PoissonCriterion().forward(x, t),
+        np.mean([1.0 - 2.0 * np.log(1.0), 5.0 - 4.0 * np.log(5.0)]),
+        rtol=1e-5)
+
+
+def test_multi_margin_matches_torch():
+    import torch
+    rng = np.random.RandomState(1)
+    x = rng.randn(5, 7).astype(np.float32)
+    y = rng.randint(0, 7, size=5)
+    for p in (1, 2):
+        ours = nn.MultiMarginCriterion(p=p).forward(
+            jnp.asarray(x), jnp.asarray(y))
+        ref = torch.nn.MultiMarginLoss(p=p)(
+            torch.tensor(x), torch.tensor(y)).item()
+        np.testing.assert_allclose(float(ours), ref, rtol=1e-5)
+
+
+def test_class_simplex_properties():
+    c = nn.ClassSimplexCriterion(5)
+    s = np.asarray(c.simplex)
+    # vertices unit-norm, mutual dot products all equal
+    np.testing.assert_allclose(np.linalg.norm(s, axis=1), 1.0, atol=1e-5)
+    dots = s @ s.T
+    off = dots[~np.eye(5, dtype=bool)]
+    np.testing.assert_allclose(off, off[0], atol=1e-5)
+    # loss is zero when input == embedding
+    t = jnp.array([0, 3])
+    emb = jnp.zeros((2, 5)).at[:, :4].set(jnp.asarray(s[np.array([0, 3])]))
+    np.testing.assert_allclose(c.forward(emb, t), 0.0, atol=1e-10)
+
+
+def test_smooth_l1_with_weights():
+    sigma = 2.0
+    x = jnp.array([[0.1, 2.0]])
+    gt = jnp.array([[0.0, 0.0]])
+    w_in = jnp.array([[1.0, 1.0]])
+    w_out = jnp.array([[2.0, 0.5]])
+    c = nn.SmoothL1CriterionWithWeights(sigma=sigma)
+    # |0.1| < 1/4 -> quad: 0.5*4*0.01 = 0.02 * w_out 2 = 0.04
+    # |2| >= 1/4 -> lin: 2 - 0.125 = 1.875 * 0.5 = 0.9375
+    np.testing.assert_allclose(
+        c.forward(x, (gt, w_in, w_out)), 0.04 + 0.9375, rtol=1e-5)
+
+
+def test_time_distributed_mask():
+    # (N=1, T=3, C=2) log-probs, last step padded (target 0 = padding)
+    logp = jnp.log(jnp.array([[[0.9, 0.1], [0.2, 0.8], [0.5, 0.5]]]))
+    tgt = jnp.array([[1, 1, 0]])
+    c = nn.TimeDistributedMaskCriterion(nn.ClassNLLCriterion(),
+                                        padding_value=0)
+    expected = -(np.log(0.1) + np.log(0.8)) / 2
+    np.testing.assert_allclose(c.forward(logp, tgt), expected, rtol=1e-5)
+
+
+def test_transformer_criterion():
+    double = nn.Lambda(lambda x: 2.0 * x)
+    c = nn.TransformerCriterion(nn.MSECriterion(),
+                                input_transformer=double,
+                                target_transformer=double)
+    x = jnp.array([[1.0, 2.0]])
+    t = jnp.array([[0.0, 0.0]])
+    np.testing.assert_allclose(c.forward(x, t), 4.0 * 2.5, rtol=1e-6)

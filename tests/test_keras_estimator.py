@@ -1,0 +1,177 @@
+"""Keras-style topology + Estimator facade tests.
+
+Mirrors the reference's Keras test strategy (``TEST/keras/`` — 91 specs
+compare behaviors, ``pyspark/test/bigdl/test_simple_integration.py`` runs
+small end-to-end fits) at the scale of the CPU mesh harness.
+"""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import keras, nn, optim
+from bigdl_tpu.estimator import NNClassifier, NNEstimator
+from bigdl_tpu.keras import (
+    Activation, Convolution2D, Dense, Dropout, Flatten, LSTM,
+    MaxPooling2D, Reshape, Sequential,
+)
+
+
+def _blobs(n=256, d=8, classes=3, seed=0):
+    """Linearly separable gaussian blobs."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, d) * 4
+    y = rng.randint(0, classes, size=n)
+    x = centers[y] + rng.randn(n, d)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+class TestKerasLayers:
+    def test_dense_shape_inference(self):
+        m = Sequential()
+        m.add(Dense(32, activation="relu", input_shape=(16,)))
+        m.add(Dense(4))
+        assert m.output_shape == (None, 4)
+
+    def test_conv_stack_shape_inference(self):
+        m = Sequential([
+            Convolution2D(6, 5, 5, input_shape=(1, 28, 28),
+                          activation="tanh"),
+            MaxPooling2D(),
+            Flatten(),
+            Dense(10, activation="softmax"),
+        ])
+        # 28 -> conv5 valid -> 24 -> pool2 -> 12; 6*12*12 = 864 flattened
+        assert m.output_shape == (None, 10)
+        core = m.core_module()
+        out = core.forward(np.zeros((2, 1, 28, 28), np.float32))
+        assert out.shape == (2, 10)
+
+    def test_lstm_return_sequences(self):
+        m = Sequential([LSTM(7, return_sequences=True,
+                             input_shape=(5, 3))])
+        assert m.output_shape == (None, 5, 7)
+        m2 = Sequential([LSTM(7, input_shape=(5, 3))])
+        assert m2.output_shape == (None, 7)
+
+    def test_unknown_activation_raises(self):
+        with pytest.raises(ValueError):
+            Sequential([Dense(4, activation="nope", input_shape=(3,))]).build()
+
+    def test_first_layer_needs_input_shape(self):
+        with pytest.raises(ValueError):
+            Sequential().add(Dense(4))
+
+
+class TestKerasFit:
+    def test_compile_fit_evaluate_predict(self):
+        x, y = _blobs()
+        m = Sequential([
+            Dense(16, activation="relu", input_shape=(8,)),
+            Dense(3, activation="softmax"),
+        ])
+        m.compile(optimizer=optim.SGD(learning_rate=0.1),
+                  loss="categorical_crossentropy", metrics=["accuracy"])
+        m.fit(x, y, batch_size=32, nb_epoch=8)
+        scores = m.evaluate(x, y)
+        acc = scores["Top1Accuracy"]
+        assert acc > 0.9, scores
+        preds = m.predict_classes(x[:64])
+        assert (preds == y[:64]).mean() > 0.85
+
+    def test_fit_with_validation(self):
+        x, y = _blobs(128)
+        m = Sequential([Dense(3, activation="softmax",
+                              input_shape=(8,))])
+        m.compile("sgd", "categorical_crossentropy", ["accuracy"])
+        m.fit(x, y, batch_size=32, nb_epoch=2, validation_data=(x, y))
+
+    def test_model_wrapping_core_module(self):
+        x, y = _blobs(128)
+        core = nn.Sequential(nn.Linear(8, 3), nn.LogSoftMax())
+        m = keras.Model(core)
+        # core ends in LogSoftMax -> pass a criterion object for log-probs
+        m.compile(optim.SGD(learning_rate=0.1),
+                  nn.ClassNLLCriterion(), ["accuracy"])
+        m.fit(x, y, batch_size=32, nb_epoch=6)
+        assert m.evaluate(x, y)["Top1Accuracy"] > 0.9
+
+
+class TestEstimator:
+    def test_classifier_fit_transform(self):
+        x, y = _blobs()
+        model = nn.Sequential(nn.Linear(8, 3), nn.LogSoftMax())
+        clf = NNClassifier(model, batch_size=32, max_epoch=8,
+                           optim_method=optim.SGD(learning_rate=0.1))
+        fitted = clf.fit(x, y)
+        preds = fitted.transform(x)
+        assert preds.shape == (len(x),)
+        assert (preds == y).mean() > 0.9
+
+    def test_estimator_regression(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(256, 4).astype(np.float32)
+        w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        y = x @ w
+        est = NNEstimator(nn.Linear(4, 1), nn.MSECriterion(),
+                          batch_size=32, max_epoch=20,
+                          optim_method=optim.SGD(learning_rate=0.05))
+        fitted = est.fit(x, y)
+        pred = fitted.transform(x)
+        assert np.mean((pred - y) ** 2) < 0.05
+
+
+class TestReviewFixes:
+    """Regressions for the round-2 code-review findings."""
+
+    def test_same_padding_even_kernel(self):
+        # Keras 'same': out = ceil(in / stride); symmetric k//2 padding
+        # would give 29 for a 2x2 kernel on 28 — must be 28
+        m = Sequential([Convolution2D(4, 2, 2, border_mode="same",
+                                      input_shape=(3, 28, 28))])
+        assert m.output_shape == (None, 4, 28, 28)
+        m2 = Sequential([Convolution2D(4, 3, 3, border_mode="same",
+                                       subsample=(2, 2),
+                                       input_shape=(3, 28, 28))])
+        assert m2.output_shape == (None, 4, 14, 14)
+
+    def test_same_pooling_shape_and_values(self):
+        m = Sequential([MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                                     border_mode="same",
+                                     input_shape=(1, 5, 5))])
+        assert m.output_shape == (None, 1, 3, 3)
+        # average 'same' must exclude padded cells from the count
+        from bigdl_tpu.keras import AveragePooling2D
+        ma = Sequential([AveragePooling2D(pool_size=(2, 2), strides=(2, 2),
+                                          border_mode="same",
+                                          input_shape=(1, 3, 3))])
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        out = ma.core_module().forward(x)
+        # bottom-right window covers only cell (2,2)=8 -> avg 8, not 8/4
+        np.testing.assert_allclose(np.asarray(out)[0, 0, 1, 1], 8.0)
+
+    def test_cropping_full_extent_gives_empty(self):
+        from bigdl_tpu.nn import Cropping2D
+        out = Cropping2D((0, 4), (0, 0)).forward(
+            np.zeros((1, 2, 4, 5), np.float32))
+        assert out.shape == (1, 2, 0, 5)
+
+    def test_categorical_crossentropy_one_hot(self):
+        from bigdl_tpu import nn as _nn
+        import jax.numpy as jnp
+        probs = jnp.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+        onehot = jnp.array([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+        ints = jnp.array([0, 1])
+        c = _nn.CategoricalCrossEntropy()
+        expected = -(np.log(0.7) + np.log(0.8)) / 2
+        np.testing.assert_allclose(c.forward(probs, onehot), expected,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(c.forward(probs, ints), expected,
+                                   rtol=1e-5)
+
+    def test_smooth_l1_rejects_two_tuple(self):
+        from bigdl_tpu import nn as _nn
+        import jax.numpy as jnp
+        c = _nn.SmoothL1CriterionWithWeights()
+        with pytest.raises(ValueError):
+            c.forward(jnp.zeros((1, 2)), (jnp.zeros((1, 2)),
+                                          jnp.ones((1, 2))))
