@@ -13,3 +13,7 @@ from bigdl_tpu.dataset.dataset import (
 )
 from bigdl_tpu.dataset import image
 from bigdl_tpu.dataset import mnist
+from bigdl_tpu.dataset import cifar
+from bigdl_tpu.dataset import text
+from bigdl_tpu.dataset import tfrecord
+from bigdl_tpu.dataset.prefetch import MTSampleToMiniBatch

@@ -1,0 +1,91 @@
+"""CIFAR-10/100 loaders.
+
+Reference: ``DL/models/resnet/DataSet.scala`` + ``models/vgg/Train.scala``
+load CIFAR-10 from the python-pickle batches or binary records; the
+recipes normalize with the per-channel train statistics below
+(``DL/models/resnet/DataSet.scala`` trainMean/trainStd) and augment with
+pad-4 random crop + horizontal flip.
+
+Supports both on-disk formats: the ``cifar-10-batches-bin`` binary records
+(1 label byte + 3072 RGB bytes) and the ``cifar-10-batches-py`` pickles.
+``synthetic_cifar`` mirrors ``mnist.synthetic_mnist`` so every example and
+test runs without the real dataset.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+from typing import List, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+
+# per-channel RGB stats of the CIFAR-10 train split, in [0, 255] scale
+# (reference ``models/resnet/DataSet.scala`` trainMean = (0.4914, 0.4822,
+# 0.4465), trainStd = (0.2470, 0.2435, 0.2616) on [0,1])
+TRAIN_MEAN = (125.31, 122.95, 113.87)
+TRAIN_STD = (62.99, 62.09, 66.70)
+
+
+def _load_bin_file(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
+    labels = raw[:, 0].astype(np.int32)
+    images = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return images, labels  # (N, 32, 32, 3) uint8 RGB, (N,)
+
+
+def _load_py_batch(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    images = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    labels = np.asarray(d.get(b"labels", d.get(b"fine_labels")), np.int32)
+    return images, labels
+
+
+def load_cifar10(folder: str, train: bool = True
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Load CIFAR-10 from ``folder`` holding either the binary batches
+    (``data_batch_1.bin``…) or python batches (``data_batch_1``…).
+    Returns (images (N,32,32,3) uint8 RGB, labels int32)."""
+    bin_names = ([f"data_batch_{i}.bin" for i in range(1, 6)]
+                 if train else ["test_batch.bin"])
+    py_names = ([f"data_batch_{i}" for i in range(1, 6)]
+                if train else ["test_batch"])
+    for names, loader in ((bin_names, _load_bin_file),
+                          (py_names, _load_py_batch)):
+        paths = [os.path.join(folder, n) for n in names]
+        # also look inside the conventional extracted dirs
+        for sub in ("cifar-10-batches-bin", "cifar-10-batches-py"):
+            alt = [os.path.join(folder, sub, n) for n in names]
+            if all(os.path.exists(p) for p in alt):
+                paths = alt
+        if all(os.path.exists(p) for p in paths):
+            parts = [loader(p) for p in paths]
+            return (np.concatenate([p[0] for p in parts]),
+                    np.concatenate([p[1] for p in parts]))
+    raise FileNotFoundError(f"no CIFAR-10 batches under {folder}")
+
+
+def synthetic_cifar(n: int = 2048, n_classes: int = 10, seed: int = 0
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic CIFAR-shaped synthetic data: class-dependent colored
+    blobs so models can actually fit it (same idea as
+    ``mnist.synthetic_mnist``)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, n_classes, n).astype(np.int32)
+    images = rng.integers(0, 40, (n, 32, 32, 3)).astype(np.float32)
+    # class signature: a bright square whose position/channel depends on y
+    for i, y in enumerate(labels):
+        r, c = divmod(int(y), 4)
+        images[i, r * 8:(r + 1) * 8, c * 8:(c + 1) * 8, y % 3] += 180.0
+    return images.astype(np.uint8), labels
+
+
+def to_samples(images: np.ndarray, labels: np.ndarray) -> List[Sample]:
+    """uint8 HWC images + int labels → Samples with float32 features."""
+    return [Sample(images[i].astype(np.float32), np.int32(labels[i]))
+            for i in range(len(images))]

@@ -20,6 +20,7 @@ import numpy as np
 
 from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.imgops import ThreadRng, color_jitter, lighting_delta
 
 
 class _SampleMap(Transformer):
@@ -72,7 +73,7 @@ class HFlip(_SampleMap):
 
     def __init__(self, threshold: float = 0.5, seed: int = 0):
         self.threshold = threshold
-        self._rng = np.random.default_rng(seed)
+        self._rng = ThreadRng(seed)
 
     def _map(self, s):
         if self._rng.random() < self.threshold:
@@ -87,7 +88,7 @@ class RandomCropper(_SampleMap):
 
     def __init__(self, crop_h: int, crop_w: int, pad: int = 0, seed: int = 0):
         self.crop_h, self.crop_w, self.pad = crop_h, crop_w, pad
-        self._rng = np.random.default_rng(seed)
+        self._rng = ThreadRng(seed)
 
     def _map(self, s):
         f = s.feature
@@ -132,40 +133,24 @@ class ColorJitter(_SampleMap):
     def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
                  saturation: float = 0.4, seed: int = 0):
         self.b, self.c, self.s = brightness, contrast, saturation
-        self._rng = np.random.default_rng(seed)
+        self._rng = ThreadRng(seed)
 
     def _map(self, s):
-        f = s.feature.astype(np.float32)
-        order = self._rng.permutation(3)
-        for op in order:
-            if op == 0 and self.b > 0:
-                f = f * (1 + self._rng.uniform(-self.b, self.b))
-            elif op == 1 and self.c > 0:
-                mean = f.mean()
-                f = (f - mean) * (1 + self._rng.uniform(-self.c, self.c)) + mean
-            elif op == 2 and self.s > 0 and f.ndim == 3:
-                grey = f.mean(axis=-1, keepdims=True)
-                f = grey + (f - grey) * (1 + self._rng.uniform(-self.s, self.s))
-        return Sample(f, s.label)
+        return Sample(color_jitter(s.feature.astype(np.float32), self._rng,
+                                   self.b, self.c, self.s), s.label)
 
 
 class Lighting(_SampleMap):
-    """AlexNet-style PCA lighting noise (reference ``Lighting``; eigen
-    vectors/values of ImageNet RGB)."""
-
-    _eigval = np.array([0.2175, 0.0188, 0.0045], np.float32)
-    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                        [-0.5808, -0.0045, -0.8140],
-                        [-0.5836, -0.6948, 0.4203]], np.float32)
+    """AlexNet-style PCA lighting noise (reference ``Lighting``; the
+    ImageNet eigen constants live in ``utils/imgops``)."""
 
     def __init__(self, alphastd: float = 0.1, seed: int = 0):
         self.alphastd = alphastd
-        self._rng = np.random.default_rng(seed)
+        self._rng = ThreadRng(seed)
 
     def _map(self, s):
-        alpha = self._rng.normal(0, self.alphastd, 3).astype(np.float32)
-        delta = (self._eigvec * alpha * self._eigval).sum(axis=1)
-        return Sample(s.feature + delta, s.label)
+        return Sample(s.feature + lighting_delta(self._rng, self.alphastd),
+                      s.label)
 
 
 class ChannelOrder(_SampleMap):

@@ -1,0 +1,200 @@
+"""Text data pipeline: Dictionary, tokenizers, sentence transformers.
+
+Reference: ``DL/dataset/text/`` (8 files) — ``Dictionary.scala`` (vocab
+with index maps, ``padding``/``unknown`` discovery), ``SentenceTokenizer``
+(OpenNLP), ``SentenceSplitter``, ``TextToLabeledSentence``,
+``LabeledSentenceToSample``, ``LabeledSentence``; plus the PTB loading in
+``DL/example/languagemodel/PTBWordLM.scala`` and
+``DL/models/rnn/Train.scala``.
+
+TPU redesign: OpenNLP's JNI tokenizer becomes a small regex tokenizer
+(identical role, no native dep); everything else is a direct functional
+analog.  Fixed-length padding/truncation happens here (host-side) so the
+jit'd step sees one static shape — the bucketing answer to the
+"PaddingParam must avoid recompilation storms" risk (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import Counter
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+SENTENCE_START = "SENTENCE_START"
+SENTENCE_END = "SENTENCE_END"
+
+
+def sentence_splitter(text: str) -> List[str]:
+    """Split running text into sentences (reference ``SentenceSplitter``,
+    OpenNLP model → punctuation heuristic)."""
+    parts = re.split(r"(?<=[.!?])\s+", text.strip())
+    return [p for p in parts if p]
+
+
+def sentence_tokenizer(sentence: str) -> List[str]:
+    """Tokenize one sentence (reference ``SentenceTokenizer``): words,
+    numbers, or single punctuation marks."""
+    return re.findall(r"[\w']+|[^\w\s]", sentence.lower())
+
+
+class SentenceTokenizer(Transformer):
+    """str → List[str] transformer form."""
+
+    def __call__(self, it: Iterator[str]) -> Iterator[List[str]]:
+        return (sentence_tokenizer(s) for s in it)
+
+
+class SentenceBiPadding(Transformer):
+    """Add SENTENCE_START/SENTENCE_END markers (reference
+    ``SentenceBiPadding.scala``)."""
+
+    def __call__(self, it):
+        for toks in it:
+            yield [SENTENCE_START] + list(toks) + [SENTENCE_END]
+
+
+class Dictionary:
+    """Vocabulary (reference ``Dictionary.scala``): word↔index maps over
+    the ``vocab_size`` most frequent words, everything else mapped to an
+    unknown token appended at the end."""
+
+    UNKNOWN = "<unk>"
+
+    def __init__(self, sentences: Optional[Iterable[Sequence[str]]] = None,
+                 vocab_size: Optional[int] = None):
+        self.word2index: Dict[str, int] = {}
+        self.index2word: List[str] = []
+        if sentences is not None:
+            counts = Counter(w for s in sentences for w in s)
+            keep = counts.most_common(vocab_size)
+            for w, _ in keep:
+                self.word2index[w] = len(self.index2word)
+                self.index2word.append(w)
+            if self.UNKNOWN not in self.word2index:
+                self.word2index[self.UNKNOWN] = len(self.index2word)
+                self.index2word.append(self.UNKNOWN)
+
+    def vocab_size(self) -> int:
+        return len(self.index2word)
+
+    def index(self, word: str) -> int:
+        return self.word2index.get(word, self.word2index[self.UNKNOWN])
+
+    def word(self, ix: int) -> str:
+        return self.index2word[ix]
+
+    def encode(self, tokens: Sequence[str]) -> np.ndarray:
+        return np.asarray([self.index(w) for w in tokens], np.int32)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            for w in self.index2word:
+                f.write(w + "\n")
+
+    @staticmethod
+    def load(path: str) -> "Dictionary":
+        d = Dictionary()
+        with open(path) as f:
+            for line in f:
+                w = line.rstrip("\n")
+                d.word2index[w] = len(d.index2word)
+                d.index2word.append(w)
+        return d
+
+
+class LabeledSentence:
+    """(data indices, label indices) pair (reference
+    ``LabeledSentence.scala``)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: np.ndarray, label: np.ndarray):
+        self.data = np.asarray(data, np.int32)
+        self.label = np.asarray(label, np.int32)
+
+
+class TextToLabeledSentence(Transformer):
+    """Language-model shift: data = tokens[:-1], label = tokens[1:]
+    (reference ``TextToLabeledSentence.scala``)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def __call__(self, it):
+        for toks in it:
+            ids = self.dictionary.encode(toks)
+            if len(ids) < 2:
+                continue
+            yield LabeledSentence(ids[:-1], ids[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """LabeledSentence → fixed-length Sample (reference
+    ``LabeledSentenceToSample.scala``).  Pads/truncates to
+    ``fixed_length`` with ``padding_value`` so the jit'd step sees ONE
+    static shape."""
+
+    def __init__(self, fixed_length: int, padding_value: int = 0,
+                 one_hot: bool = False, vocab_size: Optional[int] = None):
+        self.fixed_length = fixed_length
+        self.padding_value = padding_value
+        self.one_hot = one_hot
+        self.vocab_size = vocab_size
+
+    def _fix(self, ids: np.ndarray) -> np.ndarray:
+        L = self.fixed_length
+        if len(ids) >= L:
+            return ids[:L]
+        pad = np.full(L - len(ids), self.padding_value, np.int32)
+        return np.concatenate([ids, pad])
+
+    def __call__(self, it):
+        for ls in it:
+            data = self._fix(ls.data)
+            label = self._fix(ls.label)
+            if self.one_hot:
+                eye = np.eye(self.vocab_size, dtype=np.float32)
+                data = eye[data]
+            yield Sample(data, label)
+
+
+# --------------------------------------------------------------- PTB corpus
+def read_ptb_words(path: str) -> List[str]:
+    """Read a PTB-format file into a flat word stream with <eos> sentence
+    ends (reference ``PTBWordLM`` reading convention)."""
+    words: List[str] = []
+    with open(path) as f:
+        for line in f:
+            words.extend(line.split())
+            words.append("<eos>")
+    return words
+
+
+def ptb_batches(word_ids: np.ndarray, num_steps: int
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Contiguous (data, label) windows of ``num_steps`` (reference
+    ``PTBModel`` input prep): label is data shifted by one."""
+    n = (len(word_ids) - 1) // num_steps
+    x = word_ids[:n * num_steps].reshape(n, num_steps)
+    y = word_ids[1:n * num_steps + 1].reshape(n, num_steps)
+    return x, y
+
+
+def synthetic_corpus(n_sentences: int = 200, seed: int = 0) -> List[str]:
+    """Deterministic synthetic corpus with Zipf-ish word frequencies, for
+    examples/tests without the real PTB files."""
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(50)]
+    probs = 1.0 / np.arange(1, 51)
+    probs /= probs.sum()
+    out = []
+    for _ in range(n_sentences):
+        n = int(rng.integers(4, 12))
+        out.append(" ".join(rng.choice(vocab, size=n, p=probs)) + " .")
+    return out

@@ -50,9 +50,15 @@ from bigdl_tpu.nn.criterion import (
 )
 from bigdl_tpu.nn.graph import Graph, Input, Node
 from bigdl_tpu.nn.recurrent import (
-    Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole, MultiRNNCell,
+    Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole,
+    ConvLSTMPeephole3D, MultiRNNCell,
     Recurrent, BiRecurrent, RecurrentDecoder, TimeDistributed,
 )
+from bigdl_tpu.nn.detection import (
+    Anchor, Nms, nms, PriorBox, Proposal, RoiPooling, DetectionOutputSSD,
+    bbox_transform_inv, clip_boxes, box_iou,
+)
+from bigdl_tpu.nn.tree import TreeLSTM, BinaryTreeLSTM
 from bigdl_tpu.nn.attention import (
     LayerNorm, MultiHeadAttention, dot_product_attention,
 )

@@ -1,0 +1,449 @@
+"""Object-detection heads: Anchor, Nms, PriorBox, Proposal, RoiPooling,
+DetectionOutputSSD.
+
+Reference: ``DL/nn/Anchor.scala``, ``Nms.scala``, ``PriorBox.scala``,
+``Proposal.scala``, ``RoiPooling.scala``, ``DetectionOutputSSD.scala`` —
+the Faster-RCNN / SSD head family.
+
+TPU redesign notes:
+- The reference's NMS is a sequential suppressed-flag loop over a sorted
+  array (``Nms.scala``) — data-dependent shapes.  XLA needs static shapes,
+  so :func:`nms` here is the TPU idiom: ``lax.fori_loop`` over a FIXED
+  number of output slots, each iteration argmax-ing the best remaining box
+  and masking its overlaps.  Output is ``(indices, valid_mask)`` of static
+  length — consumers mask rather than slice.
+- RoiPooling avoids per-RoI ragged dynamic slices (recompilation storms)
+  by computing each pooled bin as a masked max over the full feature map —
+  dense, vectorized over RoIs via broadcasting, MXU/VPU friendly.
+- Proposal keeps top-k/bbox decode inside one jit region; "filter boxes
+  smaller than min_size" becomes score-masking instead of compaction.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+# --------------------------------------------------------------- bbox utils
+def bbox_transform_inv(boxes: jnp.ndarray, deltas: jnp.ndarray) -> jnp.ndarray:
+    """Decode (dx, dy, dw, dh) deltas against anchor boxes (x1, y1, x2, y2)
+    (reference ``BboxUtil.bboxTransformInv``)."""
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * w
+    cy = boxes[:, 1] + 0.5 * h
+    dx, dy, dw, dh = (deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3])
+    pcx = dx * w + cx
+    pcy = dy * h + cy
+    pw = jnp.exp(dw) * w
+    ph = jnp.exp(dh) * h
+    return jnp.stack([pcx - 0.5 * pw, pcy - 0.5 * ph,
+                      pcx + 0.5 * pw, pcy + 0.5 * ph], axis=1)
+
+
+def clip_boxes(boxes: jnp.ndarray, im_h: float, im_w: float) -> jnp.ndarray:
+    """Clip boxes to image bounds (reference ``BboxUtil.clipBoxes``)."""
+    x1 = jnp.clip(boxes[:, 0], 0.0, im_w - 1.0)
+    y1 = jnp.clip(boxes[:, 1], 0.0, im_h - 1.0)
+    x2 = jnp.clip(boxes[:, 2], 0.0, im_w - 1.0)
+    y2 = jnp.clip(boxes[:, 3], 0.0, im_h - 1.0)
+    return jnp.stack([x1, y1, x2, y2], axis=1)
+
+
+def box_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU between (N,4) and (M,4) corner boxes, +1 pixel
+    convention matching the reference's area computation."""
+    area_a = ((a[:, 2] - a[:, 0] + 1.0) * (a[:, 3] - a[:, 1] + 1.0))[:, None]
+    area_b = ((b[:, 2] - b[:, 0] + 1.0) * (b[:, 3] - b[:, 1] + 1.0))[None, :]
+    ix = (jnp.minimum(a[:, None, 2], b[None, :, 2])
+          - jnp.maximum(a[:, None, 0], b[None, :, 0]) + 1.0)
+    iy = (jnp.minimum(a[:, None, 3], b[None, :, 3])
+          - jnp.maximum(a[:, None, 1], b[None, :, 1]) + 1.0)
+    inter = jnp.maximum(ix, 0.0) * jnp.maximum(iy, 0.0)
+    return inter / (area_a + area_b - inter)
+
+
+# ---------------------------------------------------------------------- NMS
+def nms(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
+        max_output: int, iou: Optional[jnp.ndarray] = None):
+    """Static-shape NMS (TPU redesign of ``Nms.scala``'s suppressed-flag
+    loop).  Returns ``(indices, valid)``: ``indices`` has length
+    ``max_output``; ``valid[i]`` is False for unused slots.  Pass a
+    precomputed pairwise ``iou`` when suppressing the same boxes under
+    several score sets (per-class SSD) to avoid recomputing the N×N
+    matrix."""
+    n = boxes.shape[0]
+    if iou is None:
+        iou = box_iou(boxes, boxes)
+    neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
+
+    def body(i, carry):
+        live_scores, out_idx, out_valid = carry
+        best = jnp.argmax(live_scores)
+        ok = live_scores[best] > neg_inf
+        out_idx = out_idx.at[i].set(jnp.where(ok, best, -1))
+        out_valid = out_valid.at[i].set(ok)
+        # suppress the chosen box and everything overlapping it
+        suppress = (iou[best] > iou_threshold) | \
+            (jnp.arange(n) == best)
+        live_scores = jnp.where(ok & suppress, neg_inf, live_scores)
+        return live_scores, out_idx, out_valid
+
+    _, idx, valid = lax.fori_loop(
+        0, max_output, body,
+        (scores.astype(jnp.float32),
+         jnp.full((max_output,), -1, jnp.int32),
+         jnp.zeros((max_output,), bool)))
+    return idx, valid
+
+
+class Nms:
+    """Object-style wrapper (reference ``Nms.scala`` API)."""
+
+    def __call__(self, scores, boxes, thresh: float, max_output: int):
+        return nms(boxes, scores, thresh, max_output)
+
+
+# ------------------------------------------------------------------- Anchor
+class Anchor:
+    """Faster-RCNN anchor generator (reference ``Anchor.scala:25``):
+    enumerate ratios x scales around a ``base_size`` box, then shift over
+    the feature-map grid."""
+
+    def __init__(self, ratios: Sequence[float], scales: Sequence[float],
+                 base_size: int = 16):
+        self.ratios = list(ratios)
+        self.scales = list(scales)
+        self.base_size = base_size
+        self.anchor_num = len(ratios) * len(scales)
+        self.basic_anchors = self._generate_basic()  # (A, 4) np
+
+    def _generate_basic(self) -> np.ndarray:
+        """ratio enumeration then scale enumeration, rounding like the
+        reference (``generateBasicAnchors``/``ratioEnum``/``scaleEnum``)."""
+        base = np.array([0.0, 0.0, self.base_size - 1.0,
+                         self.base_size - 1.0])
+        w = base[2] - base[0] + 1
+        h = base[3] - base[1] + 1
+        cx = base[0] + 0.5 * (w - 1)
+        cy = base[1] + 0.5 * (h - 1)
+        area = w * h
+        out = []
+        for r in self.ratios:
+            ws = round(math.sqrt(area / r))
+            hs = round(ws * r)
+            for s in self.scales:
+                wss, hss = ws * s, hs * s
+                out.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+        return np.asarray(out, np.float32)
+
+    def generate_anchors(self, width: int, height: int,
+                         feat_stride: float = 16.0) -> jnp.ndarray:
+        """All anchors for a (height, width) feature map: (W*H*A, 4),
+        shifts enumerated x-fastest then y (reference
+        ``Anchor.generateAnchors:38``)."""
+        sx = jnp.arange(width, dtype=jnp.float32) * feat_stride
+        sy = jnp.arange(height, dtype=jnp.float32) * feat_stride
+        shift_x, shift_y = jnp.meshgrid(sx, sy)  # (H, W)
+        shifts = jnp.stack([shift_x, shift_y, shift_x, shift_y],
+                           axis=-1).reshape(-1, 4)  # (H*W, 4)
+        a = jnp.asarray(self.basic_anchors)  # (A, 4)
+        return (shifts[:, None, :] + a[None, :, :]).reshape(-1, 4)
+
+
+# ----------------------------------------------------------------- PriorBox
+class PriorBox(Module):
+    """SSD prior boxes for one feature map (reference ``PriorBox.scala:41``).
+    Output matches Caffe/reference layout: ``(1, 2, H*W*P*4)`` — row 0 the
+    normalized priors, row 1 the per-coordinate variances."""
+
+    def __init__(self, min_sizes: Sequence[float],
+                 max_sizes: Optional[Sequence[float]] = None,
+                 aspect_ratios: Optional[Sequence[float]] = None,
+                 is_flip: bool = True, is_clip: bool = False,
+                 variances: Optional[Sequence[float]] = None,
+                 offset: float = 0.5,
+                 img_h: int = 0, img_w: int = 0, img_size: int = 0,
+                 step_h: float = 0.0, step_w: float = 0.0, step: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.min_sizes = list(min_sizes)
+        self.max_sizes = list(max_sizes or [])
+        ars = [1.0]
+        for ar in (aspect_ratios or []):
+            if any(abs(ar - e) < 1e-6 for e in ars):
+                continue
+            ars.append(ar)
+            if is_flip:
+                ars.append(1.0 / ar)
+        self.aspect_ratios = ars
+        self.is_clip = is_clip
+        self.variances = list(variances or [0.1])
+        self.offset = offset
+        self.img_h, self.img_w = (img_h or img_size), (img_w or img_size)
+        self.step_h, self.step_w = (step_h or step), (step_w or step)
+        # priors per cell: one per min_size per aspect ratio + one per max_size
+        self.n_priors = (len(self.min_sizes) * len(self.aspect_ratios)
+                         + len(self.max_sizes))
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        # input: the feature map (N, C, H, W) — only its H/W are used
+        fh, fw = input.shape[2], input.shape[3]
+        img_h, img_w = self.img_h, self.img_w
+        step_h = self.step_h or img_h / fh
+        step_w = self.step_w or img_w / fw
+
+        widths, heights = [], []
+        for ms in self.min_sizes:
+            for ar in self.aspect_ratios:
+                if abs(ar - 1.0) < 1e-6:
+                    widths.append(ms)
+                    heights.append(ms)
+                else:
+                    widths.append(ms * math.sqrt(ar))
+                    heights.append(ms / math.sqrt(ar))
+            # between min and max (the sqrt prior), once per min_size
+            if self.max_sizes:
+                mx = self.max_sizes[self.min_sizes.index(ms)]
+                widths.append(math.sqrt(ms * mx))
+                heights.append(math.sqrt(ms * mx))
+        w = jnp.asarray(widths, jnp.float32) * 0.5
+        h = jnp.asarray(heights, jnp.float32) * 0.5
+
+        cx = (jnp.arange(fw, dtype=jnp.float32) + self.offset) * step_w
+        cy = (jnp.arange(fh, dtype=jnp.float32) + self.offset) * step_h
+        gx, gy = jnp.meshgrid(cx, cy)  # (fh, fw)
+        centers = jnp.stack([gx, gy], -1).reshape(-1, 2)  # (fh*fw, 2)
+
+        x1 = (centers[:, None, 0] - w[None, :]) / img_w
+        y1 = (centers[:, None, 1] - h[None, :]) / img_h
+        x2 = (centers[:, None, 0] + w[None, :]) / img_w
+        y2 = (centers[:, None, 1] + h[None, :]) / img_h
+        priors = jnp.stack([x1, y1, x2, y2], -1)  # (cells, P, 4)
+        if self.is_clip:
+            priors = jnp.clip(priors, 0.0, 1.0)
+        flat = priors.reshape(-1)
+
+        if len(self.variances) == 1:
+            var = jnp.full_like(flat, self.variances[0])
+        else:
+            var = jnp.tile(jnp.asarray(self.variances, jnp.float32),
+                           flat.shape[0] // 4)
+        return jnp.stack([flat, var])[None], state
+
+
+# ----------------------------------------------------------------- Proposal
+class Proposal(Module):
+    """RPN proposal layer (reference ``Proposal.scala:34``).  Input:
+    ``(scores (1, 2A, H, W), bbox_deltas (1, 4A, H, W),
+    im_info (1, >=4) = [im_h, im_w, scale_h, scale_w])``.
+    Output: ``(boxes (post_nms_topn, 5), valid (post_nms_topn,))`` where
+    column 0 is the batch index (always 0 — single image, like the
+    reference) — static shape, masked instead of truncated."""
+
+    def __init__(self, pre_nms_topn: int, post_nms_topn: int,
+                 ratios: Sequence[float], scales: Sequence[float],
+                 min_size: int = 16, nms_thresh: float = 0.7,
+                 feat_stride: float = 16.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.pre_nms_topn = pre_nms_topn
+        self.post_nms_topn = post_nms_topn
+        self.anchor = Anchor(ratios, scales)
+        self.min_size = min_size
+        self.nms_thresh = nms_thresh
+        self.feat_stride = feat_stride
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        scores, deltas, im_info = input
+        A = self.anchor.anchor_num
+        H, W = scores.shape[2], scores.shape[3]
+        # fg scores are the second half of the 2A channel block
+        fg = scores[0, A:]                         # (A, H, W)
+        fg = jnp.transpose(fg, (1, 2, 0)).reshape(-1)  # match anchor order
+        d = deltas[0].reshape(A, 4, H, W)
+        d = jnp.transpose(d, (2, 3, 0, 1)).reshape(-1, 4)
+
+        anchors = self.anchor.generate_anchors(W, H, self.feat_stride)
+        proposals = bbox_transform_inv(anchors, d)
+        im_h, im_w = im_info[0, 0], im_info[0, 1]
+        proposals = clip_boxes(proposals, im_h, im_w)
+
+        # reference filters boxes < min_size * im_scale; here: mask scores
+        ws = proposals[:, 2] - proposals[:, 0] + 1.0
+        hs = proposals[:, 3] - proposals[:, 1] + 1.0
+        min_h = self.min_size * im_info[0, 2]
+        min_w = self.min_size * im_info[0, 3]
+        keep = (ws >= min_w) & (hs >= min_h)
+        fg = jnp.where(keep, fg, -jnp.inf)
+
+        k = min(self.pre_nms_topn, fg.shape[0])
+        top_scores, top_idx = lax.top_k(fg, k)
+        top_boxes = proposals[top_idx]
+
+        idx, valid = nms(top_boxes, top_scores, self.nms_thresh,
+                         self.post_nms_topn)
+        out_boxes = top_boxes[jnp.maximum(idx, 0)]
+        out = jnp.concatenate(
+            [jnp.zeros((self.post_nms_topn, 1), out_boxes.dtype), out_boxes],
+            axis=1)
+        out = out * valid[:, None].astype(out.dtype)
+        return (out, valid), state
+
+
+# --------------------------------------------------------------- RoiPooling
+class RoiPooling(Module):
+    """RoI max pooling (reference ``RoiPooling.scala:42``).  Input:
+    ``(data (N, C, H, W), rois (R, 5) = [batch_idx, x1, y1, x2, y2])``;
+    output ``(R, C, pooled_h, pooled_w)``.
+
+    TPU design: each pooled bin = masked max over the full (H, W) map —
+    no ragged dynamic slices, fully vectorized over RoIs."""
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.pooled_w = pooled_w
+        self.pooled_h = pooled_h
+        self.spatial_scale = spatial_scale
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        data, rois = input
+        H, W = data.shape[2], data.shape[3]
+        batch_idx = rois[:, 0].astype(jnp.int32)
+        feats = jnp.take(data, batch_idx, axis=0)      # (R, C, H, W)
+
+        # RoI bounds on the feature map (reference rounds them)
+        x1 = jnp.round(rois[:, 1] * self.spatial_scale)
+        y1 = jnp.round(rois[:, 2] * self.spatial_scale)
+        x2 = jnp.round(rois[:, 3] * self.spatial_scale)
+        y2 = jnp.round(rois[:, 4] * self.spatial_scale)
+        roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_w = roi_w / self.pooled_w
+        bin_h = roi_h / self.pooled_h
+
+        ph = jnp.arange(self.pooled_h, dtype=jnp.float32)
+        pw = jnp.arange(self.pooled_w, dtype=jnp.float32)
+        # bin boundaries, clipped to the map (reference floor/ceil + clamp)
+        hstart = jnp.clip(jnp.floor(ph[None] * bin_h[:, None])
+                          + y1[:, None], 0, H)          # (R, ph)
+        hend = jnp.clip(jnp.ceil((ph[None] + 1) * bin_h[:, None])
+                        + y1[:, None], 0, H)
+        wstart = jnp.clip(jnp.floor(pw[None] * bin_w[:, None])
+                          + x1[:, None], 0, W)          # (R, pw)
+        wend = jnp.clip(jnp.ceil((pw[None] + 1) * bin_w[:, None])
+                        + x1[:, None], 0, W)
+
+        gy = jnp.arange(H, dtype=jnp.float32)
+        gx = jnp.arange(W, dtype=jnp.float32)
+        mask_h = ((gy[None, None, :] >= hstart[:, :, None])
+                  & (gy[None, None, :] < hend[:, :, None]))  # (R, ph, H)
+        mask_w = ((gx[None, None, :] >= wstart[:, :, None])
+                  & (gx[None, None, :] < wend[:, :, None]))  # (R, pw, W)
+
+        # the bin mask is separable in H and W, so chain two masked maxes
+        # instead of materializing the (R, C, ph, pw, H, W) product — peak
+        # memory O(R*C*ph*H*W), which real Faster-RCNN shapes need
+        neg = jnp.asarray(-jnp.inf, data.dtype)
+        # reduce H: (R, C, H, W) with (R, ph, H) -> (R, C, ph, W)
+        rows = jnp.where(mask_h[:, None, :, :, None],
+                         feats[:, :, None], neg).max(axis=3)
+        # reduce W: (R, C, ph, W) with (R, pw, W) -> (R, C, ph, pw)
+        out = jnp.where(mask_w[:, None, None, :, :],
+                        rows[:, :, :, None], neg).max(axis=-1)
+        # empty bins (hstart>=hend) pool to 0 like the reference
+        return jnp.where(jnp.isfinite(out), out, 0.0), state
+
+
+# ------------------------------------------------------- DetectionOutputSSD
+class DetectionOutputSSD(Module):
+    """SSD post-processing (reference ``DetectionOutputSSD.scala:49``).
+    Input: ``(loc (N, P*4), conf (N, P*n_classes), priors (1, 2, P*4))``.
+    Output: ``(dets (N, keep_topk, 6) = [label, score, x1, y1, x2, y2],
+    valid (N, keep_topk))`` — static shape, masked."""
+
+    def __init__(self, n_classes: int = 21, share_location: bool = True,
+                 bg_label: int = 0, nms_thresh: float = 0.45,
+                 nms_topk: int = 400, keep_topk: int = 200,
+                 conf_thresh: float = 0.01,
+                 variance_encoded_in_target: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if not share_location:
+            raise NotImplementedError("share_location=False not supported")
+        self.n_classes = n_classes
+        self.bg_label = bg_label
+        self.nms_thresh = nms_thresh
+        self.nms_topk = nms_topk
+        self.keep_topk = keep_topk
+        self.conf_thresh = conf_thresh
+        self.variance_encoded = variance_encoded_in_target
+
+    def _decode(self, loc, priors, variances):
+        """Caffe-style center-size decode (reference ``BboxUtil.decodeBoxes``)."""
+        pw = priors[:, 2] - priors[:, 0]
+        ph = priors[:, 3] - priors[:, 1]
+        pcx = (priors[:, 0] + priors[:, 2]) * 0.5
+        pcy = (priors[:, 1] + priors[:, 3]) * 0.5
+        v = jnp.ones_like(loc) if self.variance_encoded else variances
+        cx = v[:, 0] * loc[:, 0] * pw + pcx
+        cy = v[:, 1] * loc[:, 1] * ph + pcy
+        w = jnp.exp(v[:, 2] * loc[:, 2]) * pw
+        h = jnp.exp(v[:, 3] * loc[:, 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        loc, conf, priors = input
+        N = loc.shape[0]
+        P = priors.shape[2] // 4
+        prior_boxes = priors[0, 0].reshape(P, 4)
+        prior_vars = priors[0, 1].reshape(P, 4)
+
+        def one_image(loc_i, conf_i):
+            boxes = self._decode(loc_i.reshape(P, 4), prior_boxes,
+                                 prior_vars)
+            scores = conf_i.reshape(P, self.n_classes)
+            # share_location: every class suppresses the SAME boxes, so
+            # the P×P IoU matrix is computed once, not per class
+            iou = box_iou(boxes, boxes)
+            all_dets, all_valid = [], []
+            per_class = max(1, self.nms_topk // max(1, self.n_classes - 1))
+            for c in range(self.n_classes):
+                if c == self.bg_label:
+                    continue
+                s = jnp.where(scores[:, c] >= self.conf_thresh,
+                              scores[:, c], -jnp.inf)
+                idx, valid = nms(boxes, s, self.nms_thresh, per_class,
+                                 iou=iou)
+                b = boxes[jnp.maximum(idx, 0)]
+                sc = scores[jnp.maximum(idx, 0), c]
+                det = jnp.concatenate(
+                    [jnp.full((per_class, 1), float(c)), sc[:, None], b], 1)
+                all_dets.append(det)
+                all_valid.append(valid)
+            dets = jnp.concatenate(all_dets)          # (C*per_class, 6)
+            valid = jnp.concatenate(all_valid)
+            # keep the overall top-k by score
+            masked = jnp.where(valid, dets[:, 1], -jnp.inf)
+            k = min(self.keep_topk, masked.shape[0])
+            top_s, top_i = lax.top_k(masked, k)
+            out = dets[top_i] * jnp.isfinite(top_s)[:, None]
+            out_valid = jnp.isfinite(top_s)
+            if k < self.keep_topk:
+                pad = self.keep_topk - k
+                out = jnp.concatenate([out, jnp.zeros((pad, 6))])
+                out_valid = jnp.concatenate([out_valid,
+                                             jnp.zeros((pad,), bool)])
+            return out, out_valid
+
+        dets, valid = jax.vmap(one_image)(loc, conf)
+        return (dets, valid), state

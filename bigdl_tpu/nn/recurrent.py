@@ -231,6 +231,47 @@ class ConvLSTMPeephole(Cell):
         return h_new, (h_new, c_new)
 
 
+class ConvLSTMPeephole3D(Cell):
+    """Volumetric ConvLSTM over NCDHW feature maps (reference
+    ``ConvLSTMPeephole3D.scala``; 3-D twin of :class:`ConvLSTMPeephole`)."""
+
+    def __init__(self, input_size: int, output_size: int, kernel: int = 3,
+                 spatial: Optional[tuple[int, int, int]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+        self.kernel = kernel
+        self.spatial = spatial  # (D, H, W), required for initial_hidden
+        self.hidden_size = output_size
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        C_in, C_out, K = self.input_size, self.output_size, self.kernel
+        fan = (C_in + C_out) * K * K * K
+        w = _uniform(k1, (4 * C_out, C_in + C_out, K, K, K), fan)
+        b = _uniform(k2, (4 * C_out,), fan)
+        return {"weight": w, "bias": b}, {}
+
+    def initial_hidden(self, batch_size: int):
+        assert self.spatial is not None, \
+            "ConvLSTMPeephole3D needs spatial=(D, H, W) for initial hidden"
+        D, H, W = self.spatial
+        shape = (batch_size, self.output_size, D, H, W)
+        return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+    def step(self, params, x_t, hidden):
+        h, c = hidden
+        z = lax.conv_general_dilated(
+            jnp.concatenate([x_t, h], axis=1), params["weight"],
+            window_strides=(1, 1, 1), padding="SAME",
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        z = z + params["bias"][None, :, None, None, None]
+        i, f, g, o = jnp.split(z, 4, axis=1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
 class MultiRNNCell(Cell):
     """Stack cells vertically (reference ``MultiRNNCell.scala``)."""
 

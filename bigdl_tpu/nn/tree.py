@@ -1,0 +1,144 @@
+"""Tree-structured LSTMs: TreeLSTM base + BinaryTreeLSTM.
+
+Reference: ``DL/nn/TreeLSTM.scala`` and ``DL/nn/BinaryTreeLSTM.scala``
+(constituency Tree-LSTM, Tai et al. 2015).  The reference encodes each
+tree as a tensor (``TensorTree``, ``BinaryTreeLSTM.scala:478``): row i =
+``[left_child, right_child, leaf_index]`` with 1-based indices and 0
+meaning "none", and runs a *recursive* Scala forward, dynamically growing
+leaf/composer module clones.
+
+TPU redesign: recursion and per-node module clones cannot live under XLA.
+Instead a single ``lax.scan`` walks the node array **in topological order
+(children before parents — required; 0-padding rows allowed)** carrying
+``(c, h)`` buffers for all nodes; each step computes BOTH the leaf and
+composer update and selects with ``jnp.where`` (2x compute for
+static-shape control flow — the standard TPU trade).  All leaves share
+one parameter set and all composers another, which is exactly the
+reference's weight-sharing (``shareParams``) without the clone machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.initialization import Xavier
+from bigdl_tpu.nn.module import Module
+
+
+class TreeLSTM(Module):
+    """Base: holds sizes (reference ``TreeLSTM.scala``)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+
+class BinaryTreeLSTM(TreeLSTM):
+    """Constituency Tree-LSTM (reference ``BinaryTreeLSTM.scala:40``).
+
+    Input: ``(embeddings (B, n_leaves, input_size),
+    trees (B, n_nodes, 3))`` with rows ``[left, right, leaf_idx]``
+    (1-based, 0 = none), nodes topologically ordered (children first).
+    Output: ``(B, n_nodes, hidden_size)`` — the hidden state of every
+    node, matching the reference's output layout.
+    """
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True, name: Optional[str] = None):
+        super().__init__(input_size, hidden_size, name)
+        self.gate_output = gate_output
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 12)
+        D, H = self.input_size, self.hidden_size
+        xav = Xavier()
+
+        def lin(k, i, o):
+            return {"w": xav.init(k, (i, o), i, o),
+                    "b": jnp.zeros((o,))}
+
+        params = {
+            # leaf module (reference createLeafModule: c = Wx,
+            # o = sigmoid(W_o x), h = o * tanh(c))
+            "leaf_c": lin(ks[0], D, H),
+            "leaf_o": lin(ks[1], D, H),
+            # composer (createComposer): gates from (lh, rh)
+            "comp_i_l": lin(ks[2], H, H), "comp_i_r": lin(ks[3], H, H),
+            "comp_lf_l": lin(ks[4], H, H), "comp_lf_r": lin(ks[5], H, H),
+            "comp_rf_l": lin(ks[6], H, H), "comp_rf_r": lin(ks[7], H, H),
+            "comp_u_l": lin(ks[8], H, H), "comp_u_r": lin(ks[9], H, H),
+            "comp_o_l": lin(ks[10], H, H), "comp_o_r": lin(ks[11], H, H),
+        }
+        return params, {}
+
+    @staticmethod
+    def _aff(p, x):
+        return x @ p["w"] + p["b"]
+
+    def _leaf(self, params, x):
+        c = self._aff(params["leaf_c"], x)
+        if self.gate_output:
+            o = jax.nn.sigmoid(self._aff(params["leaf_o"], x))
+            h = o * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return c, h
+
+    def _compose(self, params, lc, lh, rc, rh):
+        i = jax.nn.sigmoid(self._aff(params["comp_i_l"], lh)
+                           + self._aff(params["comp_i_r"], rh))
+        lf = jax.nn.sigmoid(self._aff(params["comp_lf_l"], lh)
+                            + self._aff(params["comp_lf_r"], rh))
+        rf = jax.nn.sigmoid(self._aff(params["comp_rf_l"], lh)
+                            + self._aff(params["comp_rf_r"], rh))
+        u = jnp.tanh(self._aff(params["comp_u_l"], lh)
+                     + self._aff(params["comp_u_r"], rh))
+        c = i * u + lf * lc + rf * rc
+        if self.gate_output:
+            o = jax.nn.sigmoid(self._aff(params["comp_o_l"], lh)
+                               + self._aff(params["comp_o_r"], rh))
+            h = o * jnp.tanh(c)
+        else:
+            h = jnp.tanh(c)
+        return c, h
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        embeddings, trees = input
+        H = self.hidden_size
+        n_nodes = trees.shape[1]
+        trees = trees.astype(jnp.int32)
+
+        def one_tree(emb, tree):
+            # state buffers indexed 1..n_nodes; slot 0 = zeros ("no child")
+            c_buf = jnp.zeros((n_nodes + 1, H), emb.dtype)
+            h_buf = jnp.zeros((n_nodes + 1, H), emb.dtype)
+
+            def step(carry, node_ix):
+                c_buf, h_buf = carry
+                left, right, leaf = (tree[node_ix, 0], tree[node_ix, 1],
+                                     tree[node_ix, 2])
+                is_leaf = (left == 0) & (leaf > 0)
+                is_node = left > 0
+                x = emb[jnp.maximum(leaf - 1, 0)]
+                lc, lh = c_buf[left], h_buf[left]
+                rc, rh = c_buf[right], h_buf[right]
+                cl, hl = self._leaf(params, x)
+                cn, hn = self._compose(params, lc, lh, rc, rh)
+                c = jnp.where(is_leaf, cl, jnp.where(is_node, cn, 0.0))
+                h = jnp.where(is_leaf, hl, jnp.where(is_node, hn, 0.0))
+                c_buf = c_buf.at[node_ix + 1].set(c)
+                h_buf = h_buf.at[node_ix + 1].set(h)
+                return (c_buf, h_buf), None
+
+            (c_buf, h_buf), _ = lax.scan(step, (c_buf, h_buf),
+                                         jnp.arange(n_nodes))
+            return h_buf[1:]
+
+        out = jax.vmap(one_tree)(embeddings, trees)
+        return out, state

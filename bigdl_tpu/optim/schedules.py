@@ -53,13 +53,19 @@ class Step(LearningRateSchedule):
 
 
 class MultiStep(LearningRateSchedule):
-    """Drop by gamma at each listed iteration (reference ``SGD.scala:360``)."""
+    """Drop by gamma at each listed iteration (reference ``SGD.scala:360``);
+    ``epoch_based=True`` reads the thresholds as epochs instead (the
+    reference expresses that via an ``EpochSchedule`` Regime — e.g. the
+    TrainCIFAR10 80/120 recipe)."""
 
-    def __init__(self, step_sizes: Sequence[int], gamma: float = 0.1):
+    def __init__(self, step_sizes: Sequence[int], gamma: float = 0.1,
+                 epoch_based: bool = False):
         self.step_sizes, self.gamma = list(step_sizes), gamma
+        self.epoch_based = epoch_based
 
     def __call__(self, base_lr, iteration, epoch, metric=None):
-        n = sum(1 for s in self.step_sizes if iteration >= s)
+        at = epoch if self.epoch_based else iteration
+        n = sum(1 for s in self.step_sizes if at >= s)
         return base_lr * self.gamma ** n
 
 

@@ -15,3 +15,6 @@ from bigdl_tpu.parallel.tensor_parallel import (
     build_param_specs, column_parallel_linear_specs,
     row_parallel_linear_specs,
 )
+from bigdl_tpu.parallel.pipeline import (
+    GPipe, MicrobatchedSequential, partition_sequential,
+)

@@ -1,0 +1,12 @@
+"""bigdl_tpu.transform — vision-2.0 style data transforms.
+
+Reference: ``DL/transform/vision/`` (30 files, 4,008 LoC).
+"""
+
+from bigdl_tpu.transform.vision import (
+    ImageFeature, ImageFrame, LocalImageFrame, FeatureTransformer,
+    Brightness, Contrast, Saturation, Hue, ChannelNormalize, PixelNormalizer,
+    Expand, Filler, HFlip, Resize, AspectScale, RandomAspectScale,
+    CenterCrop, RandomCrop, FixedCrop, RandomAlterAspect, ChannelOrder,
+    ColorJitter, Lighting, RandomTransformer, MatToFloats, ImageFrameToSample,
+)

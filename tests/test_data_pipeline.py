@@ -1,0 +1,284 @@
+"""Data-pipeline round-2 tests: TFRecord, CIFAR, vision-2.0 transforms,
+text pipeline, MT prefetch assembler.
+
+Reference test analogs: ``TEST/dataset/`` + ``TEST/transform/vision/``
+specs + ``TFRecordIterator`` usage in the TF importer tests.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (DataSet, MTSampleToMiniBatch,
+                               SampleToMiniBatch, cifar, text, tfrecord)
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.transform import vision as V
+
+
+class TestTFRecord:
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "x.tfrecord")
+        tfrecord.write_examples(p, [
+            {"img": b"abc", "label": 3, "w": np.array([1.0, 2.0])},
+            {"img": b"de", "label": np.array([-1, 5]), "w": [0.25]},
+        ])
+        exs = list(tfrecord.read_examples(p))
+        assert exs[0]["img"] == [b"abc"]
+        assert exs[0]["label"].tolist() == [3]
+        np.testing.assert_allclose(exs[1]["w"], [0.25])
+        assert exs[1]["label"].tolist() == [-1, 5]
+
+    def test_crc_detects_corruption(self, tmp_path):
+        p = str(tmp_path / "x.tfrecord")
+        tfrecord.write_records(p, [b"payload-one"])
+        raw = bytearray(open(p, "rb").read())
+        raw[14] ^= 0xFF  # flip a payload byte
+        open(p, "wb").write(bytes(raw))
+        with pytest.raises(IOError):
+            list(tfrecord.read_records(p))
+
+    def test_reads_reference_tf_file_if_present(self):
+        p = ("/root/reference/spark/dl/src/test/resources/tf/"
+             "mnist_train.tfrecord")
+        if not os.path.exists(p):
+            pytest.skip("reference resources not available")
+        exs = list(tfrecord.read_examples(p))
+        assert len(exs) == 10
+        assert exs[0]["image/encoded"][0][:4] == b"\x89PNG"
+        assert 0 <= int(exs[0]["image/class/label"][0]) <= 9
+
+
+class TestCifar:
+    def test_synthetic_learnable_format(self):
+        imgs, labels = cifar.synthetic_cifar(64)
+        assert imgs.shape == (64, 32, 32, 3) and imgs.dtype == np.uint8
+        assert labels.min() >= 0 and labels.max() <= 9
+
+    def test_bin_format_loader(self, tmp_path):
+        # fabricate one binary batch in the CIFAR-10 layout
+        n = 10
+        rng = np.random.RandomState(0)
+        labels = rng.randint(0, 10, n).astype(np.uint8)
+        imgs = rng.randint(0, 255, (n, 3, 32, 32)).astype(np.uint8)
+        rec = np.concatenate([labels[:, None],
+                              imgs.reshape(n, -1)], axis=1)
+        d = tmp_path / "cifar-10-batches-bin"
+        d.mkdir()
+        for i in range(1, 6):
+            rec.tofile(str(d / f"data_batch_{i}.bin"))
+        rec.tofile(str(d / "test_batch.bin"))
+        tr_i, tr_l = cifar.load_cifar10(str(tmp_path), train=True)
+        te_i, te_l = cifar.load_cifar10(str(tmp_path), train=False)
+        assert tr_i.shape == (50, 32, 32, 3)
+        assert te_i.shape == (10, 32, 32, 3)
+        np.testing.assert_array_equal(te_l, labels)
+        # channel order: record is CHW planes -> loader returns HWC
+        np.testing.assert_array_equal(te_i[0, :, :, 0], imgs[0, 0])
+
+
+class TestVisionTransforms:
+    def _feat(self, seed=0):
+        rng = np.random.RandomState(seed)
+        return V.ImageFeature(rng.randint(0, 255, (8, 6, 3)).astype(
+            np.float32), label=1)
+
+    def test_frame_pipeline_compose(self):
+        frame = V.ImageFrame.array(
+            [np.full((4, 4, 3), 100.0, np.float32)], [0])
+        frame = (frame >> V.Brightness(10, 10)
+                 >> V.ChannelNormalize((110, 110, 110), (1, 1, 1))
+                 >> V.ImageFrameToSample())
+        s = frame.features[0]["sample"]
+        assert s.feature.shape == (3, 4, 4)
+        np.testing.assert_allclose(s.feature, 0.0)
+
+    def test_hsv_roundtrip(self):
+        rng = np.random.RandomState(3)
+        img = rng.randint(0, 255, (5, 5, 3)).astype(np.float32)
+        back = V._hsv_to_rgb(V._rgb_to_hsv(img))
+        np.testing.assert_allclose(back, img, atol=0.5)
+
+    def test_saturation_grey_is_fixed_point(self):
+        grey = np.full((4, 4, 3), 128.0, np.float32)
+        f = V.Saturation(0.5, 0.5).transform(V.ImageFeature(grey))
+        np.testing.assert_allclose(f.image, grey, atol=0.6)
+
+    def test_resize_and_aspect_scale(self):
+        f = self._feat()
+        V.Resize(16, 12).transform(f)
+        assert f.image.shape == (16, 12, 3)
+        f2 = V.ImageFeature(np.zeros((100, 50, 3), np.float32))
+        V.AspectScale(min_size=25).transform(f2)
+        assert f2.image.shape == (50, 25, 3)
+
+    def test_resize_bilinear_values(self):
+        img = np.array([[0.0, 2.0], [4.0, 6.0]], np.float32)
+        out = V._resize_bilinear(img, 4, 4)
+        assert out.shape == (4, 4)
+        # corners preserved-ish, monotone rows
+        assert out[0, 0] == 0.0 and out[-1, -1] == 6.0
+        assert (np.diff(out, axis=1) >= 0).all()
+
+    def test_expand_and_random_alter_aspect(self):
+        f = self._feat()
+        V.Expand(max_expand_ratio=2.0, seed=1).transform(f)
+        assert f.image.shape[0] >= 8 and f.image.shape[1] >= 6
+        f2 = self._feat()
+        V.RandomAlterAspect(target_size=7, seed=2).transform(f2)
+        assert f2.image.shape == (7, 7, 3)
+
+    def test_crops_and_flip(self):
+        f = self._feat()
+        V.CenterCrop(4, 4).transform(f)
+        assert f.image.shape == (4, 4, 3)
+        g = self._feat()
+        img0 = g.image.copy()
+        V.HFlip(threshold=1.1).transform(g)  # always flips
+        np.testing.assert_allclose(g.image, img0[:, ::-1])
+
+    def test_random_transformer_prob(self):
+        always = V.RandomTransformer(V.Brightness(5, 5), prob=1.0)
+        never = V.RandomTransformer(V.Brightness(5, 5), prob=0.0)
+        base = np.zeros((2, 2, 3), np.float32)
+        np.testing.assert_allclose(
+            always.transform(V.ImageFeature(base.copy())).image, 5.0)
+        np.testing.assert_allclose(
+            never.transform(V.ImageFeature(base.copy())).image, 0.0)
+
+
+class TestTextPipeline:
+    def test_tokenizer_and_dictionary(self):
+        sents = [text.sentence_tokenizer(s)
+                 for s in ["The cat sat.", "The dog sat!"]]
+        d = text.Dictionary(sents, vocab_size=4)
+        assert d.vocab_size() == 5  # 4 words + <unk>
+        assert d.index("the") != d.index("sat")
+        assert d.index("zebra") == d.word2index[text.Dictionary.UNKNOWN]
+
+    def test_dictionary_save_load(self, tmp_path):
+        d = text.Dictionary([["a", "b", "a"]])
+        p = str(tmp_path / "vocab.txt")
+        d.save(p)
+        d2 = text.Dictionary.load(p)
+        assert d2.word2index == d.word2index
+
+    def test_labeled_sentence_pipeline(self):
+        corpus = text.synthetic_corpus(20)
+        toks = [text.sentence_tokenizer(s) for s in corpus]
+        d = text.Dictionary(toks)
+        pipe = (text.TextToLabeledSentence(d)
+                >> text.LabeledSentenceToSample(fixed_length=12))
+        samples = list(pipe(iter(toks)))
+        assert len(samples) == 20
+        for s in samples:
+            assert s.feature.shape == (12,) and s.label.shape == (12,)
+        # shift property on an unpadded prefix
+        raw = d.encode(toks[0])
+        np.testing.assert_array_equal(samples[0].feature[:len(raw) - 1],
+                                      raw[:-1])
+        np.testing.assert_array_equal(samples[0].label[:len(raw) - 1],
+                                      raw[1:])
+
+    def test_ptb_batches(self):
+        ids = np.arange(21)
+        x, y = text.ptb_batches(ids, num_steps=5)
+        assert x.shape == (4, 5)
+        np.testing.assert_array_equal(y, x + 1)
+
+
+class TestMTPrefetch:
+    def test_batches_match_serial(self):
+        samples = [Sample(np.full((3,), i, np.float32), np.int32(i % 2))
+                   for i in range(37)]
+
+        def tf(s):
+            return Sample(s.feature * 2.0, s.label)
+
+        mt = MTSampleToMiniBatch(8, tf, workers=4, prefetch=2)
+        batches = list(mt(iter(samples)))
+        assert len(batches) == 4  # 37 // 8, remainder dropped
+        flat = np.concatenate([b.input for b in batches])
+        np.testing.assert_allclose(flat[:, 0], np.arange(32) * 2.0)
+
+    def test_keep_remainder(self):
+        samples = [Sample(np.zeros(2, np.float32), np.int32(0))
+                   for _ in range(10)]
+        mt = MTSampleToMiniBatch(4, None, drop_remainder=False)
+        sizes = [b.size() for b in mt(iter(samples))]
+        assert sizes == [4, 4, 2]
+
+    def test_worker_error_propagates(self):
+        def bad(s):
+            raise RuntimeError("boom")
+
+        mt = MTSampleToMiniBatch(2, bad)
+        with pytest.raises(RuntimeError):
+            list(mt(iter([Sample(np.zeros(1), np.int32(0))] * 4)))
+
+    def test_prefetch_overlaps(self):
+        # producer keeps the queue full while the consumer is slow
+        samples = [Sample(np.zeros(1, np.float32), np.int32(0))
+                   for _ in range(24)]
+        mt = MTSampleToMiniBatch(4, None, workers=2, prefetch=3)
+        it = mt(iter(samples))
+        first = next(it)
+        time.sleep(0.05)  # let the producer run ahead
+        rest = list(it)
+        assert 1 + len(rest) == 6
+
+
+class TestReviewFixes:
+    """Regressions for round-2 review findings on the data pipeline."""
+
+    def test_random_transforms_advance_between_samples(self):
+        # one instance must give different draws per call (a fresh instance
+        # per sample used to replay the identical 'random' crop forever)
+        from bigdl_tpu.dataset import image
+        rng_img = np.random.RandomState(0).rand(40, 40, 3).astype(np.float32)
+        crop = image.RandomCropper(8, 8)
+        outs = {bytes(next(iter(crop(iter([Sample(rng_img, 0)])))).feature)
+                for _ in range(20)}
+        assert len(outs) > 1, "RandomCropper draws never advance"
+        flip = image.HFlip(threshold=0.5)
+        decisions = {bool(np.allclose(
+            next(iter(flip(iter([Sample(rng_img, 0)])))).feature, rng_img))
+            for _ in range(50)}
+        assert decisions == {True, False}, "HFlip never varies"
+
+    def test_thread_rng_distinct_across_threads(self):
+        from concurrent.futures import ThreadPoolExecutor
+        from bigdl_tpu.utils.imgops import ThreadRng
+        rng = ThreadRng(1)
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            draws = list(pool.map(lambda _: rng.random(), range(8)))
+        assert len(set(draws)) > 1
+
+    def test_prefetch_consumer_early_exit_unblocks_producer(self):
+        import threading
+        before = threading.active_count()
+        samples = [Sample(np.zeros(4, np.float32), np.int32(0))
+                   for _ in range(512)]
+        mt = MTSampleToMiniBatch(4, None, workers=2, prefetch=1)
+        it = mt(iter(samples))
+        next(it)
+        it.close()  # early exit mid-epoch
+        deadline = time.time() + 5.0
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before + 1, \
+            "producer thread leaked after early consumer exit"
+
+    def test_shared_lighting_constants(self):
+        from bigdl_tpu.dataset import image
+        from bigdl_tpu.transform import vision as V
+        from bigdl_tpu.utils import imgops
+        # both stacks consume the same kernel (no drifting copies)
+        f = V.Lighting(alphastd=0.0).transform(
+            V.ImageFeature(np.zeros((2, 2, 3), np.float32)))
+        np.testing.assert_allclose(f.image, 0.0)
+        s = next(iter(image.Lighting(alphastd=0.0)(
+            iter([Sample(np.zeros((2, 2, 3), np.float32), 0)]))))
+        np.testing.assert_allclose(s.feature, 0.0)
+        assert imgops.LIGHTING_EIGVAL.shape == (3,)

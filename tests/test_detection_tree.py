@@ -1,0 +1,195 @@
+"""Detection heads + tree LSTM tests (reference: ``TEST/nn/AnchorSpec``,
+``NmsSpec``, ``PriorBoxSpec``, ``ProposalSpec``, ``RoiPoolingSpec``,
+``BinaryTreeLSTMSpec``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+
+class TestAnchor:
+    def test_basic_anchors_centered(self):
+        a = nn.Anchor(ratios=[1.0], scales=[8.0])
+        # single ratio-1 scale-8 anchor on a 16-base: 128x128 centered at 7.5
+        b = a.basic_anchors[0]
+        assert b[2] - b[0] + 1 == 128 and b[3] - b[1] + 1 == 128
+        np.testing.assert_allclose((b[0] + b[2]) / 2, 7.5)
+
+    def test_grid_generation(self):
+        a = nn.Anchor(ratios=[0.5, 1.0, 2.0], scales=[8.0, 16.0, 32.0])
+        all_a = a.generate_anchors(width=4, height=3, feat_stride=16)
+        assert all_a.shape == (4 * 3 * 9, 4)
+        # second grid cell is shifted +16 in x
+        np.testing.assert_allclose(np.asarray(all_a[9]) -
+                                   np.asarray(all_a[0]),
+                                   [16, 0, 16, 0])
+
+
+class TestNms:
+    def test_suppresses_overlaps(self):
+        boxes = jnp.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                          jnp.float32)
+        scores = jnp.array([0.9, 0.8, 0.7])
+        idx, valid = nn.nms(boxes, scores, iou_threshold=0.5, max_output=3)
+        kept = np.asarray(idx)[np.asarray(valid)]
+        assert list(kept) == [0, 2]
+
+    def test_static_shape_under_jit(self):
+        f = jax.jit(lambda b, s: nn.nms(b, s, 0.5, 4))
+        boxes = jnp.array([[0, 0, 5, 5]] * 8, jnp.float32)
+        scores = jnp.arange(8, dtype=jnp.float32)
+        idx, valid = f(boxes, scores)
+        assert idx.shape == (4,) and valid.shape == (4,)
+        assert int(np.asarray(valid).sum()) == 1  # all identical -> 1 kept
+
+
+class TestPriorBox:
+    def test_caffe_layout_and_values(self):
+        pb = nn.PriorBox(min_sizes=[30.0], max_sizes=[60.0],
+                         aspect_ratios=[2.0], is_flip=True,
+                         variances=[0.1, 0.1, 0.2, 0.2],
+                         img_h=300, img_w=300, step=8.0)
+        # priors per cell: 1 (ar=1) + 2 (ar=2 + flip) + 1 (max) = 4
+        assert pb.n_priors == 4
+        x = jnp.zeros((1, 8, 2, 2))
+        out = pb.forward(x)
+        assert out.shape == (1, 2, 2 * 2 * 4 * 4)
+        pr = np.asarray(out)[0, 0].reshape(2, 2, 4, 4)
+        # first cell center = (0.5*8, 0.5*8); ar=1 box is min_size square
+        c00 = pr[0, 0, 0]
+        np.testing.assert_allclose(c00, [(4 - 15) / 300, (4 - 15) / 300,
+                                         (4 + 15) / 300, (4 + 15) / 300],
+                                   rtol=1e-5)
+        var = np.asarray(out)[0, 1].reshape(-1, 4)
+        np.testing.assert_allclose(var, np.tile([0.1, 0.1, 0.2, 0.2],
+                                                (var.shape[0], 1)))
+
+
+class TestProposal:
+    def test_shapes_and_validity(self):
+        A = 9
+        H = W = 6
+        rng = np.random.RandomState(0)
+        scores = jnp.asarray(rng.rand(1, 2 * A, H, W).astype(np.float32))
+        deltas = jnp.asarray(
+            (rng.rand(1, 4 * A, H, W).astype(np.float32) - 0.5) * 0.1)
+        im_info = jnp.array([[96.0, 96.0, 1.0, 1.0]])
+        prop = nn.Proposal(pre_nms_topn=50, post_nms_topn=10,
+                           ratios=[0.5, 1.0, 2.0], scales=[2.0, 4.0, 8.0])
+        (out, valid), _ = prop.apply({}, {}, (scores, deltas, im_info))
+        assert out.shape == (10, 5)
+        assert np.asarray(valid).any()
+        v = np.asarray(out)[np.asarray(valid)]
+        # batch column zero; boxes inside the image
+        assert (v[:, 0] == 0).all()
+        assert (v[:, 1] >= 0).all() and (v[:, 3] <= 95).all()
+
+
+class TestRoiPooling:
+    def test_matches_torchvision_semantics(self):
+        # hand-checkable case: 1x1x4x4 map, one RoI covering all, 2x2 pool
+        data = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        rois = jnp.array([[0, 0, 0, 3, 3]], jnp.float32)
+        rp = nn.RoiPooling(pooled_w=2, pooled_h=2, spatial_scale=1.0)
+        out, _ = rp.apply({}, {}, (data, rois))
+        np.testing.assert_allclose(np.asarray(out)[0, 0],
+                                   [[5, 7], [13, 15]])
+
+    def test_batch_indexing_and_scale(self):
+        rng = np.random.RandomState(1)
+        data = jnp.asarray(rng.rand(2, 3, 8, 8).astype(np.float32))
+        # x2=14 * scale 0.5 -> feature x2=7 -> roi width exactly 8 cells
+        rois = jnp.array([[0, 0, 0, 14, 14], [1, 0, 0, 14, 14]], jnp.float32)
+        rp = nn.RoiPooling(pooled_w=4, pooled_h=4, spatial_scale=0.5)
+        out, _ = rp.apply({}, {}, (data, rois))
+        assert out.shape == (2, 3, 4, 4)
+        # full-coverage 4x4 pool of an 8x8 map = 2x2 max blocks
+        expected = np.asarray(data[1, 0]).reshape(4, 2, 4, 2).max((1, 3))
+        np.testing.assert_allclose(np.asarray(out)[1, 0], expected)
+
+
+class TestDetectionOutputSSD:
+    def test_decode_and_nms(self):
+        P, C = 4, 3
+        priors = np.zeros((1, 2, P * 4), np.float32)
+        boxes = np.array([[0.1, 0.1, 0.3, 0.3], [0.11, 0.11, 0.31, 0.31],
+                          [0.6, 0.6, 0.8, 0.8], [0.0, 0.0, 1.0, 1.0]],
+                         np.float32)
+        priors[0, 0] = boxes.reshape(-1)
+        priors[0, 1] = np.tile([0.1, 0.1, 0.2, 0.2], P)
+        loc = jnp.zeros((1, P * 4))  # zero deltas -> boxes = priors
+        conf = np.full((1, P, C), 0.01, np.float32)
+        conf[0, 0, 1] = 0.9   # class 1 on box 0
+        conf[0, 1, 1] = 0.8   # overlapping -> suppressed
+        conf[0, 2, 2] = 0.7   # class 2 on box 2
+        det = nn.DetectionOutputSSD(n_classes=C, keep_topk=5,
+                                    conf_thresh=0.1)
+        (dets, valid), _ = det.apply(
+            {}, {}, (loc, jnp.asarray(conf.reshape(1, -1)), priors))
+        v = np.asarray(dets)[0][np.asarray(valid)[0]]
+        assert len(v) == 2
+        # sorted by score: class 1 @0.9 then class 2 @0.7
+        np.testing.assert_allclose(v[0, :2], [1, 0.9], rtol=1e-5)
+        np.testing.assert_allclose(v[1, :2], [2, 0.7], rtol=1e-5)
+        np.testing.assert_allclose(v[0, 2:], boxes[0], atol=1e-5)
+
+
+class TestBinaryTreeLSTM:
+    def _simple_tree(self):
+        # nodes (1-based): 1=leaf1, 2=leaf2, 3=compose(1,2)
+        tree = np.array([[[0, 0, 1], [0, 0, 2], [1, 2, 0]]], np.float32)
+        emb = np.random.RandomState(0).rand(1, 2, 5).astype(np.float32)
+        return jnp.asarray(emb), jnp.asarray(tree)
+
+    def test_forward_shapes_and_root(self):
+        emb, tree = self._simple_tree()
+        m = nn.BinaryTreeLSTM(input_size=5, hidden_size=7)
+        p, s = m.init(jax.random.PRNGKey(0))
+        out, _ = m.apply(p, s, (emb, tree))
+        assert out.shape == (1, 3, 7)
+        o = np.asarray(out)
+        assert np.abs(o).sum() > 0
+        # root state differs from leaves
+        assert not np.allclose(o[0, 2], o[0, 0])
+
+    def test_padding_rows_are_zero(self):
+        emb, tree = self._simple_tree()
+        padded = jnp.concatenate(
+            [tree, jnp.zeros((1, 2, 3), tree.dtype)], axis=1)
+        m = nn.BinaryTreeLSTM(5, 7)
+        p, s = m.init(jax.random.PRNGKey(0))
+        out, _ = m.apply(p, s, (emb, padded))
+        o = np.asarray(out)
+        np.testing.assert_allclose(o[0, 3:], 0.0)
+        ref, _ = m.apply(p, s, (emb, tree))
+        np.testing.assert_allclose(o[0, :3], np.asarray(ref)[0], rtol=1e-6)
+
+    def test_grad_flows(self):
+        emb, tree = self._simple_tree()
+        m = nn.BinaryTreeLSTM(5, 7)
+        p, s = m.init(jax.random.PRNGKey(0))
+
+        def loss(p, e):
+            out, _ = m.apply(p, s, (e, tree))
+            return jnp.sum(out[:, -1] ** 2)
+
+        g_p, g_e = jax.grad(loss, argnums=(0, 1))(p, emb)
+        leaves = jax.tree_util.tree_leaves(g_p)
+        assert any(np.abs(np.asarray(l)).sum() > 0 for l in leaves)
+        assert np.abs(np.asarray(g_e)).sum() > 0
+
+    def test_deep_tree_under_jit(self):
+        # right-leaning chain of 4 leaves
+        # nodes: 1..4 leaves; 5=compose(3,4); 6=compose(2,5); 7=compose(1,6)
+        tree = np.array([[[0, 0, 1], [0, 0, 2], [0, 0, 3], [0, 0, 4],
+                          [3, 4, 0], [2, 5, 0], [1, 6, 0]]], np.float32)
+        emb = np.random.RandomState(1).rand(1, 4, 5).astype(np.float32)
+        m = nn.BinaryTreeLSTM(5, 6)
+        p, s = m.init(jax.random.PRNGKey(0))
+        out = jax.jit(lambda p, e: m.apply(p, s, (e, jnp.asarray(tree)))[0])(
+            p, jnp.asarray(emb))
+        assert out.shape == (1, 7, 6)
+        assert np.isfinite(np.asarray(out)).all()

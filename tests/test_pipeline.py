@@ -1,0 +1,97 @@
+"""Pipeline-parallelism tests (beyond-reference capability; SURVEY §2.9
+row "Pipeline parallelism: absent in reference").  Runs on the 8-device
+CPU mesh from conftest."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.parallel import (GPipe, MicrobatchedSequential,
+                                create_mesh, partition_sequential)
+
+
+class TestPartition:
+    def test_balanced_split(self):
+        m = nn.Sequential(*[nn.Linear(4, 4) for _ in range(7)])
+        stages = partition_sequential(m, 3)
+        assert [len(s) for s in stages] == [3, 2, 2]
+
+    def test_invalid_split_raises(self):
+        m = nn.Sequential(nn.Linear(4, 4))
+        with pytest.raises(ValueError):
+            partition_sequential(m, 2)
+
+
+class TestGPipe:
+    def _build(self, pipe=4, data=2):
+        mesh = create_mesh(data=data, pipe=pipe)
+        stage = nn.Sequential(nn.Linear(12, 12), nn.Tanh())
+        gp = GPipe(stage, num_stages=pipe, mesh=mesh)
+        params, _ = gp.init(jax.random.PRNGKey(0))
+        return gp, params
+
+    def test_matches_sequential_reference(self):
+        gp, params = self._build()
+        x = jax.random.normal(jax.random.PRNGKey(1), (6, 4, 12))
+        out, _ = gp.apply(params, {}, x)
+        ref = gp.apply_reference(params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_grad_matches_reference(self):
+        gp, params = self._build(pipe=2, data=4)
+        x = jax.random.normal(jax.random.PRNGKey(2), (4, 4, 12))
+
+        def loss_pipe(p):
+            o, _ = gp.apply(p, {}, x)
+            return jnp.mean(o ** 2)
+
+        def loss_ref(p):
+            return jnp.mean(gp.apply_reference(p, x) ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(params)
+        g_ref = jax.grad(loss_ref)(params)
+        for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_sharded_params_execute(self):
+        # place stage params with the pipe sharding and run under jit
+        gp, params = self._build()
+        sharded = jax.device_put(params, gp.stage_sharding())
+        x = jax.random.normal(jax.random.PRNGKey(3), (4, 2, 12))
+        out = jax.jit(lambda p, x: gp.apply(p, {}, x)[0])(sharded, x)
+        assert out.shape == (4, 2, 12)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestMicrobatched:
+    def test_identical_to_unpipelined(self):
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 16), nn.Tanh(),
+                              nn.Linear(16, 4))
+        stages = partition_sequential(model, 3)
+        mb = MicrobatchedSequential(stages, num_microbatches=4)
+        params, state = mb.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+        out, _ = mb.apply(params, state, x)
+
+        flat = nn.Sequential(*[m for st in stages for m in st.modules])
+        fp = {}
+        k = 0
+        for i, st in enumerate(stages):
+            for j in range(len(st.modules)):
+                fp[str(k)] = params[str(i)][str(j)]
+                k += 1
+        ref, _ = flat.apply(fp, {str(i): {} for i in range(k)}, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_indivisible_batch_raises(self):
+        mb = MicrobatchedSequential([nn.Identity()], num_microbatches=3)
+        p, s = mb.init(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError):
+            mb.apply(p, s, jnp.zeros((8, 2)))
