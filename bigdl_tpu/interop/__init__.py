@@ -1,0 +1,11 @@
+"""bigdl_tpu.interop — model import/export (reference L6 layer).
+
+Reference: ``DL/utils/serializer/`` (BigDL protobuf checkpoints),
+``DL/utils/tf/`` (TensorFlow GraphDef), ``DL/utils/caffe/``,
+``DL/utils/TorchFile.scala``, ``DL/utils/ConvertModel.scala``.
+"""
+
+from bigdl_tpu.interop.bigdl_format import (
+    load_bigdl_module, save_bigdl_module, decode_bigdl_module,
+)
+from bigdl_tpu.interop.tf_format import load_tf_graph

@@ -1,0 +1,57 @@
+"""Model conversion CLI.
+
+Reference: ``DL/utils/ConvertModel.scala:24-46`` —
+``--from {bigdl,caffe,torch,tensorflow} --to {bigdl,...}``.  Supported
+here: ``tensorflow → bigdl`` and ``bigdl → bigdl`` (re-serialize); the
+native ``.npz`` training checkpoint (``utils/checkpoint``) also exports
+to the reference format via ``bigdl``.
+
+Usage:
+    python -m bigdl_tpu.interop.convert_model \
+        --from tensorflow --input g.pb --inputs x --outputs out \
+        --to bigdl --output model.bigdl
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Convert models between formats")
+    p.add_argument("--from", dest="src_fmt", required=True,
+                   choices=["bigdl", "tensorflow"])
+    p.add_argument("--to", dest="dst_fmt", required=True,
+                   choices=["bigdl"])
+    p.add_argument("--input", required=True, help="source model file")
+    p.add_argument("--output", required=True, help="destination file")
+    p.add_argument("--inputs", default=None,
+                   help="comma-separated TF input node names")
+    p.add_argument("--outputs", default=None,
+                   help="comma-separated TF output node names")
+    args = p.parse_args(argv)
+
+    from bigdl_tpu.interop import (load_bigdl_module, load_tf_graph,
+                                   save_bigdl_module)
+
+    if args.src_fmt == "tensorflow":
+        if not (args.inputs and args.outputs):
+            p.error("tensorflow source needs --inputs and --outputs")
+        model = load_tf_graph(args.input, args.inputs.split(","),
+                              args.outputs.split(","))
+    else:
+        model = load_bigdl_module(args.input)
+
+    if args.dst_fmt == "bigdl":
+        if args.src_fmt == "tensorflow":
+            raise SystemExit(
+                "tensorflow→bigdl structural conversion is not supported: "
+                "an imported TF graph executes natively (TFGraphModule); "
+                "save its checkpoint with utils/checkpoint instead")
+        save_bigdl_module(model, args.output)
+    print(f"converted {args.input} ({args.src_fmt}) -> "
+          f"{args.output} ({args.dst_fmt})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,17 @@
-"""(populated in subsequent milestones)"""
+"""bigdl_tpu.ops — forward-only TF op execution layer.
+
+Reference: ``DL/nn/ops/`` (71 files) + ``DL/nn/tf/`` (18 files): each TF
+op the importer can meet is a forward-only ``Operation`` module executing
+Torch-tensor math.  TPU redesign: an op is a pure function
+``(attrs, *input_arrays) -> array`` registered by TF op name — the
+imported graph executes as ONE jit-traced composition of these, so XLA
+fuses the whole imported model instead of interpreting op-by-op.
+
+The op set is scoped to what the importer needs for the benchmark-model
+graphs (SURVEY §7 stage 10: "only as far as the TF importer needs"),
+and grows with it.
+"""
+
+from bigdl_tpu.ops.registry import OPS, register_op, get_op
+
+__all__ = ["OPS", "register_op", "get_op"]

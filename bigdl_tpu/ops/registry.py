@@ -1,0 +1,422 @@
+"""TF op implementations (forward-only), keyed by TF op name.
+
+Reference: ``DL/nn/ops/*.scala`` — e.g. ``MatMul``, ``BiasAdd``, ``Cast``,
+``OneHot``, ``Select``, ``TopK`` — and the layout notes in
+``DL/utils/tf/loaders/``.  Each op here is ``fn(attrs, *inputs) -> out``
+over jnp arrays; ``attrs`` is the decoded NodeDef attr dict.
+
+Conventions: TF convs/pools default NHWC (attr ``data_format``), SAME/
+VALID padding strings map straight onto lax's; reductions take the axis
+tensor as a runtime input but it must be constant-foldable (the importer
+feeds numpy for Const-derived inputs, so plain int conversion works under
+trace).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+OPS: Dict[str, Callable] = {}
+
+
+def register_op(name: str):
+    def deco(fn):
+        OPS[name] = fn
+        return fn
+    return deco
+
+
+def get_op(name: str) -> Callable:
+    if name not in OPS:
+        raise NotImplementedError(
+            f"TF op {name!r} not implemented (bigdl_tpu.ops registry has "
+            f"{len(OPS)} ops; reference analog DL/nn/ops/)")
+    return OPS[name]
+
+
+def _axes(axis_input) -> tuple:
+    a = np.asarray(axis_input).reshape(-1)
+    return tuple(int(v) for v in a)
+
+
+# ------------------------------------------------------------- passthrough
+@register_op("Identity")
+@register_op("StopGradient")
+@register_op("PreventGradient")
+def _identity(attrs, x):
+    return x
+
+
+@register_op("Cast")
+def _cast(attrs, x):
+    dt = attrs.get("DstT", attrs.get("dstT", 1))
+    mapping = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 9: jnp.int64,
+               10: jnp.bool_, 14: jnp.bfloat16}
+    return jnp.asarray(x).astype(mapping.get(int(dt), jnp.float32))
+
+
+# ------------------------------------------------------------------- math
+_BINOPS = {
+    "Add": jnp.add, "AddV2": jnp.add, "Sub": jnp.subtract,
+    "Mul": jnp.multiply, "RealDiv": jnp.divide, "Div": jnp.divide,
+    "Maximum": jnp.maximum, "Minimum": jnp.minimum, "Pow": jnp.power,
+    "FloorDiv": jnp.floor_divide, "Mod": jnp.mod,
+    "SquaredDifference": lambda a, b: (a - b) ** 2,
+    "Equal": lambda a, b: jnp.equal(a, b),
+    "NotEqual": lambda a, b: jnp.not_equal(a, b),
+    "Greater": jnp.greater, "GreaterEqual": jnp.greater_equal,
+    "Less": jnp.less, "LessEqual": jnp.less_equal,
+    "LogicalAnd": jnp.logical_and, "LogicalOr": jnp.logical_or,
+}
+for _name, _fn in _BINOPS.items():
+    OPS[_name] = (lambda f: lambda attrs, a, b: f(a, b))(_fn)
+
+_UNOPS = {
+    "Neg": jnp.negative, "Abs": jnp.abs, "Exp": jnp.exp, "Log": jnp.log,
+    "Sqrt": jnp.sqrt, "Rsqrt": lambda x: 1.0 / jnp.sqrt(x),
+    "Square": jnp.square, "Floor": jnp.floor, "Ceil": jnp.ceil,
+    "Round": jnp.round, "Sign": jnp.sign, "Reciprocal": jnp.reciprocal,
+    "Tanh": jnp.tanh, "Sigmoid": jax.nn.sigmoid, "Relu": jax.nn.relu,
+    "Relu6": lambda x: jnp.clip(x, 0.0, 6.0), "Elu": jax.nn.elu,
+    "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign,
+    "LogicalNot": jnp.logical_not, "Erf": jax.scipy.special.erf,
+    "Selu": jax.nn.selu,
+}
+for _name, _fn in _UNOPS.items():
+    OPS[_name] = (lambda f: lambda attrs, x: f(x))(_fn)
+
+
+@register_op("AddN")
+def _addn(attrs, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register_op("MatMul")
+def _matmul(attrs, a, b):
+    if attrs.get("transpose_a", False):
+        a = a.T
+    if attrs.get("transpose_b", False):
+        b = b.T
+    return a @ b
+
+
+@register_op("BatchMatMul")
+@register_op("BatchMatMulV2")
+def _batch_matmul(attrs, a, b):
+    if attrs.get("adj_x", False):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("adj_y", False):
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register_op("Softmax")
+def _softmax(attrs, x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register_op("LogSoftmax")
+def _log_softmax(attrs, x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+@register_op("L2Loss")
+def _l2loss(attrs, x):
+    return jnp.sum(x * x) / 2.0
+
+
+@register_op("Select")
+@register_op("SelectV2")
+def _select(attrs, c, a, b):
+    return jnp.where(c, a, b)
+
+
+# ------------------------------------------------------------- reductions
+def _make_reduce(fn):
+    def op(attrs, x, axis):
+        keep = bool(attrs.get("keep_dims", attrs.get("keepdims", False)))
+        ax = _axes(axis)
+        if not ax and np.asarray(axis).size == 0:
+            ax = tuple(range(jnp.ndim(x)))
+        return fn(x, axis=ax, keepdims=keep)
+    return op
+
+
+OPS["Sum"] = _make_reduce(jnp.sum)
+OPS["Mean"] = _make_reduce(jnp.mean)
+OPS["Max"] = _make_reduce(jnp.max)
+OPS["Min"] = _make_reduce(jnp.min)
+OPS["Prod"] = _make_reduce(jnp.prod)
+OPS["All"] = _make_reduce(jnp.all)
+OPS["Any"] = _make_reduce(jnp.any)
+
+
+@register_op("ArgMax")
+def _argmax(attrs, x, axis):
+    return jnp.argmax(x, axis=int(np.asarray(axis)))
+
+
+@register_op("ArgMin")
+def _argmin(attrs, x, axis):
+    return jnp.argmin(x, axis=int(np.asarray(axis)))
+
+
+# ------------------------------------------------------------ shape ops
+@register_op("Reshape")
+def _reshape(attrs, x, shape):
+    return jnp.reshape(x, tuple(int(v) for v in np.asarray(shape)))
+
+
+@register_op("Squeeze")
+def _squeeze(attrs, x):
+    dims = attrs.get("squeeze_dims", attrs.get("axis", []))
+    if dims:
+        return jnp.squeeze(x, axis=tuple(int(d) for d in dims))
+    return jnp.squeeze(x)
+
+
+@register_op("ExpandDims")
+def _expand_dims(attrs, x, axis):
+    return jnp.expand_dims(x, int(np.asarray(axis)))
+
+
+@register_op("Shape")
+def _shape(attrs, x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+@register_op("Rank")
+def _rank(attrs, x):
+    return jnp.asarray(jnp.ndim(x), jnp.int32)
+
+
+@register_op("Size")
+def _size(attrs, x):
+    return jnp.asarray(jnp.size(x), jnp.int32)
+
+
+@register_op("Fill")
+def _fill(attrs, shape, value):
+    return jnp.full(tuple(int(v) for v in np.asarray(shape)),
+                    jnp.asarray(value))
+
+
+@register_op("Pack")
+def _pack(attrs, *xs):
+    return jnp.stack(xs, axis=int(attrs.get("axis", 0)))
+
+
+@register_op("Unpack")
+def _unpack(attrs, x):
+    return tuple(jnp.moveaxis(x, int(attrs.get("axis", 0)), 0))
+
+
+@register_op("ConcatV2")
+def _concat_v2(attrs, *args):
+    *xs, axis = args
+    return jnp.concatenate(xs, axis=int(np.asarray(axis)))
+
+
+@register_op("Concat")
+def _concat(attrs, axis, *xs):
+    return jnp.concatenate(xs, axis=int(np.asarray(axis)))
+
+
+@register_op("Slice")
+def _slice(attrs, x, begin, size):
+    begin = [int(v) for v in np.asarray(begin)]
+    size = [int(v) for v in np.asarray(size)]
+    size = [x.shape[i] - begin[i] if s == -1 else s
+            for i, s in enumerate(size)]
+    return lax.slice(x, begin, [b + s for b, s in zip(begin, size)])
+
+
+@register_op("StridedSlice")
+def _strided_slice(attrs, x, begin, end, strides):
+    # basic masks only (begin/end masks as bit fields)
+    if int(attrs.get("ellipsis_mask", 0)) or \
+            int(attrs.get("new_axis_mask", 0)):
+        raise NotImplementedError(
+            "StridedSlice ellipsis_mask/new_axis_mask not supported")
+    begin = [int(v) for v in np.asarray(begin)]
+    end = [int(v) for v in np.asarray(end)]
+    strides = [int(v) for v in np.asarray(strides)]
+    bm = int(attrs.get("begin_mask", 0))
+    em = int(attrs.get("end_mask", 0))
+    sa = int(attrs.get("shrink_axis_mask", 0))
+    idx = []
+    for i in range(len(begin)):
+        b = None if (bm >> i) & 1 else begin[i]
+        e = None if (em >> i) & 1 else end[i]
+        if (sa >> i) & 1:
+            idx.append(begin[i])
+        else:
+            idx.append(slice(b, e, strides[i]))
+    return x[tuple(idx)]
+
+
+@register_op("Transpose")
+def _transpose(attrs, x, perm):
+    return jnp.transpose(x, tuple(int(v) for v in np.asarray(perm)))
+
+
+@register_op("Pad")
+@register_op("PadV2")
+def _pad(attrs, x, paddings, *rest):
+    pads = [(int(a), int(b)) for a, b in np.asarray(paddings)]
+    cv = float(np.asarray(rest[0])) if rest else 0.0
+    return jnp.pad(x, pads, constant_values=cv)
+
+
+@register_op("Tile")
+def _tile(attrs, x, multiples):
+    return jnp.tile(x, tuple(int(v) for v in np.asarray(multiples)))
+
+
+@register_op("GatherV2")
+@register_op("Gather")
+def _gather(attrs, params, indices, *axis):
+    ax = int(np.asarray(axis[0])) if axis else 0
+    return jnp.take(params, jnp.asarray(indices).astype(jnp.int32), axis=ax)
+
+
+@register_op("OneHot")
+def _one_hot(attrs, indices, depth, on_value, off_value):
+    d = int(np.asarray(depth))
+    on = jnp.asarray(on_value)
+    off = jnp.asarray(off_value)
+    oh = jax.nn.one_hot(jnp.asarray(indices).astype(jnp.int32), d)
+    return oh * on + (1.0 - oh) * off
+
+
+# --------------------------------------------------------- nn/image ops
+def _data_format(attrs) -> str:
+    df = attrs.get("data_format", b"NHWC")
+    if isinstance(df, bytes):
+        df = df.decode()
+    return df or "NHWC"
+
+
+@register_op("BiasAdd")
+def _bias_add(attrs, x, b):
+    if _data_format(attrs) == "NCHW" and jnp.ndim(x) == 4:
+        return x + b[None, :, None, None]
+    return x + b
+
+
+@register_op("Conv2D")
+def _conv2d(attrs, x, w):
+    # w: HWIO (TF kernel layout)
+    df = _data_format(attrs)
+    strides = [int(s) for s in attrs.get("strides", [1, 1, 1, 1])]
+    pad = attrs.get("padding", b"SAME")
+    pad = pad.decode() if isinstance(pad, bytes) else pad
+    if df == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+        ws = (strides[1], strides[2])
+    else:
+        dn = ("NCHW", "HWIO", "NCHW")
+        ws = (strides[2], strides[3])
+    return lax.conv_general_dilated(x, w, window_strides=ws, padding=pad,
+                                    dimension_numbers=dn)
+
+
+@register_op("DepthwiseConv2dNative")
+def _depthwise_conv(attrs, x, w):
+    df = _data_format(attrs)
+    strides = [int(s) for s in attrs.get("strides", [1, 1, 1, 1])]
+    pad = attrs.get("padding", b"SAME")
+    pad = pad.decode() if isinstance(pad, bytes) else pad
+    H, W, C, M = w.shape
+    w2 = jnp.reshape(jnp.transpose(w, (0, 1, 3, 2)), (H, W, 1, C * M))
+    if df == "NHWC":
+        dn = ("NHWC", "HWIO", "NHWC")
+        ws = (strides[1], strides[2])
+    else:
+        dn = ("NCHW", "HWIO", "NCHW")
+        ws = (strides[2], strides[3])
+    return lax.conv_general_dilated(x, w2, window_strides=ws, padding=pad,
+                                    dimension_numbers=dn,
+                                    feature_group_count=C)
+
+
+def _pool(attrs, x, reducer, init, avg=False):
+    # ksize/strides already arrive in the graph's data-format order, so
+    # no layout branch is needed
+    ks = [int(v) for v in attrs.get("ksize", [1, 2, 2, 1])]
+    st = [int(v) for v in attrs.get("strides", [1, 2, 2, 1])]
+    pad = attrs.get("padding", b"VALID")
+    pad = pad.decode() if isinstance(pad, bytes) else pad
+    dims, strides = tuple(ks), tuple(st)
+    out = lax.reduce_window(x, init, reducer, dims, strides, pad)
+    if avg:
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+        out = out / cnt
+    return out
+
+
+@register_op("MaxPool")
+def _max_pool(attrs, x):
+    return _pool(attrs, x, lax.max, -jnp.inf)
+
+
+@register_op("AvgPool")
+def _avg_pool(attrs, x):
+    return _pool(attrs, x, lax.add, 0.0, avg=True)
+
+
+@register_op("FusedBatchNorm")
+@register_op("FusedBatchNormV2")
+@register_op("FusedBatchNormV3")
+def _fused_bn(attrs, x, scale, offset, mean, var):
+    eps = float(attrs.get("epsilon", 1e-3))
+    df = _data_format(attrs)
+    if df == "NCHW":
+        shape = (1, -1, 1, 1)
+    else:
+        shape = (1, 1, 1, -1)
+    inv = 1.0 / jnp.sqrt(var + eps)
+    return ((x - mean.reshape(shape)) * inv.reshape(shape)
+            * scale.reshape(shape) + offset.reshape(shape))
+
+
+@register_op("SoftmaxCrossEntropyWithLogits")
+def _softmax_ce(attrs, logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(labels * logp, axis=-1)
+
+
+# -------------------------------------------------------------- random ops
+def _op_key(attrs) -> jax.Array:
+    """Deterministic key from the node's seed attrs (imported graphs run
+    under jit with no rng plumbing; reference ``DL/nn/ops/RandomUniform``
+    similarly seeds from the node)."""
+    s = int(attrs.get("seed", 0)) * 2654435761 + int(attrs.get("seed2", 0))
+    return jax.random.PRNGKey(s & 0x7FFFFFFF)
+
+
+@register_op("RandomUniform")
+def _random_uniform(attrs, shape):
+    return jax.random.uniform(_op_key(attrs),
+                              tuple(int(v) for v in np.asarray(shape)))
+
+
+@register_op("RandomStandardNormal")
+def _random_normal(attrs, shape):
+    return jax.random.normal(_op_key(attrs),
+                             tuple(int(v) for v in np.asarray(shape)))
+
+
+@register_op("TruncatedNormal")
+def _truncated_normal(attrs, shape):
+    return jax.random.truncated_normal(
+        _op_key(attrs), -2.0, 2.0, tuple(int(v) for v in np.asarray(shape)))
