@@ -24,13 +24,20 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def _default_retry_times() -> int:
+    from bigdl_tpu.utils.config import get_config
+    return get_config().failure_retry_times
+
+
 @dataclass
 class _EngineState:
     initialized: bool = False
     mesh: Optional[Mesh] = None
     seed: int = 1
-    # reference knob: bigdl.failure.retryTimes (DistriOptimizer retry loop)
-    failure_retry_times: int = 5
+    # reference knob: bigdl.failure.retryTimes (DistriOptimizer retry
+    # loop); default flows from the unified typed config
+    # (utils/config.Config.failure_retry_times, env BIGDL_TPU_*)
+    failure_retry_times: int = field(default_factory=_default_retry_times)
 
 
 class Engine:

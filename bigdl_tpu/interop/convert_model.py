@@ -2,14 +2,15 @@
 
 Reference: ``DL/utils/ConvertModel.scala:24-46`` —
 ``--from {bigdl,caffe,torch,tensorflow} --to {bigdl,...}``.  Supported
-here: ``tensorflow → bigdl`` and ``bigdl → bigdl`` (re-serialize); the
-native ``.npz`` training checkpoint (``utils/checkpoint``) also exports
-to the reference format via ``bigdl``.
+conversion: ``bigdl → bigdl`` (re-serialize, e.g. to normalize storage
+layout).  ``tensorflow`` sources load and execute natively as
+``TFGraphModule`` (no structural conversion to the bigdl layer tree), so
+``tensorflow → bigdl`` is rejected up front — save an imported graph's
+weights with ``utils/checkpoint`` instead.
 
 Usage:
     python -m bigdl_tpu.interop.convert_model \
-        --from tensorflow --input g.pb --inputs x --outputs out \
-        --to bigdl --output model.bigdl
+        --from bigdl --input model.bigdl --to bigdl --output copy.bigdl
 """
 
 from __future__ import annotations
@@ -31,23 +32,20 @@ def main(argv=None):
                    help="comma-separated TF output node names")
     args = p.parse_args(argv)
 
-    from bigdl_tpu.interop import (load_bigdl_module, load_tf_graph,
-                                   save_bigdl_module)
+    # validate the combination BEFORE any expensive load
+    if args.src_fmt == "tensorflow" and args.dst_fmt == "bigdl":
+        p.error(
+            "tensorflow->bigdl structural conversion is not supported: an "
+            "imported TF graph executes natively (TFGraphModule); load it "
+            "with interop.load_tf_graph and save its weights with "
+            "utils/checkpoint instead")
+    if args.src_fmt == "tensorflow" and not (args.inputs and args.outputs):
+        p.error("tensorflow source needs --inputs and --outputs")
 
-    if args.src_fmt == "tensorflow":
-        if not (args.inputs and args.outputs):
-            p.error("tensorflow source needs --inputs and --outputs")
-        model = load_tf_graph(args.input, args.inputs.split(","),
-                              args.outputs.split(","))
-    else:
-        model = load_bigdl_module(args.input)
+    from bigdl_tpu.interop import load_bigdl_module, save_bigdl_module
 
+    model = load_bigdl_module(args.input)
     if args.dst_fmt == "bigdl":
-        if args.src_fmt == "tensorflow":
-            raise SystemExit(
-                "tensorflow→bigdl structural conversion is not supported: "
-                "an imported TF graph executes natively (TFGraphModule); "
-                "save its checkpoint with utils/checkpoint instead")
         save_bigdl_module(model, args.output)
     print(f"converted {args.input} ({args.src_fmt}) -> "
           f"{args.output} ({args.dst_fmt})")

@@ -321,6 +321,71 @@ def _base_name(inp: str) -> Tuple[str, int]:
     return inp, 0
 
 
+# ------------------------------------------------ control flow (tf.cond)
+# The reference executes loaded control flow with a dataflow Scheduler over
+# Enter/Exit/Switch/Merge frames (``DL/nn/Scheduler.scala:104-145``) —
+# dead-token propagation, host-driven.  Under XLA, data-dependent
+# branching compiles to "execute both branches, select" — so Switch tags
+# each branch's values with (predicate, branch) provenance and Merge emits
+# ``jnp.where(pred, true_val, false_val)``.  Loop frames would need
+# ``lax.while_loop`` reconstruction and are rejected explicitly.
+class _Tagged:
+    """A value that flowed through a Switch branch; ``tags`` maps the
+    predicate node name → (pred array, branch bool)."""
+
+    __slots__ = ("value", "tags")
+
+    def __init__(self, value, tags):
+        self.value = value
+        self.tags = tags
+
+
+def _tag_value(a):
+    return a.value if isinstance(a, _Tagged) else a
+
+
+def _union_tags(args) -> dict:
+    tags: dict = {}
+    for a in args:
+        if isinstance(a, _Tagged):
+            tags.update(a.tags)
+    return tags
+
+
+def _exec_switch(args, pred_name: str):
+    data, pred = args[0], args[1]
+    base = _union_tags(args)
+    d, p = _tag_value(data), _tag_value(pred)
+    false_out = _Tagged(d, {**base, pred_name: (p, False)})
+    true_out = _Tagged(d, {**base, pred_name: (p, True)})
+    return (false_out, true_out)  # TF Switch ports: 0=false, 1=true
+
+
+def _exec_merge(args):
+    import jax.numpy as jnp
+    tagged = [a for a in args if isinstance(a, _Tagged)]
+    keys: set = set()
+    for t in tagged:
+        keys |= set(t.tags)
+    for key in keys:
+        branches = {}
+        for a in tagged:
+            if key in a.tags:
+                branches[a.tags[key][1]] = a
+        if True in branches and False in branches:
+            pred = branches[True].tags[key][0]
+            sel = jnp.where(pred, _tag_value(branches[True]),
+                            _tag_value(branches[False]))
+            rest = _union_tags(tagged)
+            rest.pop(key, None)
+            out = _Tagged(sel, rest) if rest else sel
+            return (out, jnp.asarray(0, jnp.int32))
+    if len(args) == 1:  # one live input (other side pruned)
+        return (args[0], jnp.asarray(0, jnp.int32))
+    raise NotImplementedError(
+        "Merge whose inputs don't trace to complementary Switch branches")
+
+
 class TFGraphModule(Module):
     """Executable imported graph (reference ``Session``-less analog of the
     BigDL ``Graph`` built by ``buildBigDLModel``).
@@ -451,7 +516,8 @@ class TFGraphModule(Module):
             if not ok:
                 continue
             try:
-                out = get_op(op)(node["attrs"], *args)
+                out = get_op(op)(
+                    {**node["attrs"], "_node_name": nm}, *args)
             except NotImplementedError:
                 continue
             folded[nm] = (tuple(np.asarray(o) for o in out)
@@ -481,7 +547,8 @@ class TFGraphModule(Module):
                 return None
             args.append(v)
         try:
-            out = get_op(node["op"])(node["attrs"], *args)
+            out = get_op(node["op"])(
+                {**node["attrs"], "_node_name": nm}, *args)
         except Exception:
             return None
         return None if isinstance(out, tuple) else np.asarray(out)
@@ -521,12 +588,28 @@ class TFGraphModule(Module):
                         continue
                     v = values[b]
                     args.append(v[ix] if isinstance(v, tuple) else v)
-                values[nm] = get_op(op)(node["attrs"], *args)
+                if op in ("Enter", "Exit", "NextIteration", "LoopCond"):
+                    raise NotImplementedError(
+                        f"TF while-loop frame op {op!r} ({nm}): loop "
+                        "import is not supported (conditionals via "
+                        "Switch/Merge are)")
+                if op == "Switch":
+                    pred_name = _base_name(node["inputs"][1])[0]
+                    values[nm] = _exec_switch(args, pred_name)
+                elif op == "Merge":
+                    values[nm] = _exec_merge(args)
+                else:
+                    raw = [_tag_value(a) for a in args]
+                    tags = _union_tags(args)
+                    out = get_op(op)(
+                        {**node["attrs"], "_node_name": nm}, *raw)
+                    values[nm] = _Tagged(out, tags) if tags else out
         outs = []
         for o in self.output_names:
             b, ix = _base_name(o)
             v = values[b]
-            outs.append(v[ix] if isinstance(v, tuple) else v)
+            v = v[ix] if isinstance(v, tuple) else v
+            outs.append(_tag_value(v))
         out = outs[0] if len(outs) == 1 else tuple(outs)
         return out, state
 

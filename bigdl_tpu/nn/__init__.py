@@ -59,6 +59,9 @@ from bigdl_tpu.nn.detection import (
     bbox_transform_inv, clip_boxes, box_iou,
 )
 from bigdl_tpu.nn.tree import TreeLSTM, BinaryTreeLSTM
+from bigdl_tpu.nn.quantized import (
+    quantize, QuantizedLinear, QuantizedSpatialConvolution,
+)
 from bigdl_tpu.nn.attention import (
     LayerNorm, MultiHeadAttention, dot_product_attention,
 )

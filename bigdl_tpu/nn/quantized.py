@@ -1,0 +1,175 @@
+"""Post-training int8 quantization.
+
+Reference: ``DL/nn/quantized/Quantization.scala`` (``model.quantize()``
+converts Linear/SpatialConvolution/… to quantized twins) +
+``quantized/Linear.scala:79-90`` (BigQuant mixed-precision GEMM: int8
+weights per-output-channel, activations quantized on the fly, int32
+accumulate, dequantize).
+
+TPU redesign (SURVEY §7 stage 9): the BigQuant JNI kernels become
+``lax.dot_general``/``lax.conv_general_dilated`` on int8 operands with
+``preferred_element_type=int32`` — XLA lowers that onto the MXU's int8
+path natively.  Scheme matches the reference's:
+
+- weights: symmetric per-output-channel int8
+  (``scale_o = max|W_o| / 127``);
+- activations: symmetric per-tensor dynamic int8, the max computed on the
+  fly per batch exactly like BigQuant's runtime quantization;
+- accumulation int32, dequantize with ``x_scale * w_scale_o``, add the
+  f32 bias.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.nn.layers import Linear, SpatialConvolution, _conv_dims
+from bigdl_tpu.nn.module import Container, Module
+
+
+def _quantize_symmetric(w: np.ndarray, axis=None):
+    """Return (int8 values, f32 scale) with symmetric range mapping."""
+    amax = np.max(np.abs(w), axis=axis, keepdims=axis is not None)
+    scale = np.maximum(amax, 1e-8) / 127.0
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, np.asarray(scale, np.float32)
+
+
+def _dyn_quantize(x: jnp.ndarray):
+    """Per-tensor dynamic activation quantization (traced; scale is a
+    runtime value like BigQuant's on-the-fly quantization)."""
+    amax = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    scale = amax / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+class QuantizedLinear(Module):
+    """int8 Linear (reference ``quantized/Linear.scala``)."""
+
+    def __init__(self, weight_q: np.ndarray, weight_scale: np.ndarray,
+                 bias: Optional[np.ndarray], name: Optional[str] = None):
+        super().__init__(name)
+        self.weight_q = jnp.asarray(weight_q)          # (out, in) int8
+        self.weight_scale = jnp.asarray(weight_scale)  # (out, 1)
+        self.bias = None if bias is None else jnp.asarray(bias)
+
+    @staticmethod
+    def from_linear(m: Linear, params) -> "QuantizedLinear":
+        wq, ws = _quantize_symmetric(np.asarray(params["weight"]), axis=1)
+        b = np.asarray(params["bias"]) if "bias" in params else None
+        return QuantizedLinear(wq, ws, b, name=m.name)
+
+    def init(self, rng):
+        return {}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        xq, xs = _dyn_quantize(input)
+        acc = lax.dot_general(
+            xq, self.weight_q.T,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (xs * self.weight_scale[:, 0][None])
+        if self.bias is not None:
+            y = y + self.bias
+        return y, state
+
+
+class QuantizedSpatialConvolution(Module):
+    """int8 conv (reference ``quantized/SpatialConvolution.scala``)."""
+
+    def __init__(self, conv: SpatialConvolution, weight_q, weight_scale,
+                 bias, name: Optional[str] = None):
+        super().__init__(name or conv.name)
+        self.conv = conv
+        self.weight_q = jnp.asarray(weight_q)          # OIHW int8
+        self.weight_scale = jnp.asarray(weight_scale)  # (O,1,1,1)
+        self.bias = None if bias is None else jnp.asarray(bias)
+
+    @staticmethod
+    def from_conv(m: SpatialConvolution, params
+                  ) -> "QuantizedSpatialConvolution":
+        wq, ws = _quantize_symmetric(np.asarray(params["weight"]),
+                                     axis=(1, 2, 3))
+        b = np.asarray(params["bias"]) if "bias" in params else None
+        return QuantizedSpatialConvolution(m, wq, ws, b)
+
+    def init(self, rng):
+        return {}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        m = self.conv
+        xq, xs = _dyn_quantize(input)
+        w = self.weight_q
+        if m.format == "NHWC":
+            w = jnp.transpose(w, (2, 3, 1, 0))
+        ph, pw_ = m.pad
+        padding = "SAME" if (ph == -1 or pw_ == -1) else ((ph, ph),
+                                                          (pw_, pw_))
+        acc = lax.conv_general_dilated(
+            xq, w, window_strides=m.stride, padding=padding,
+            rhs_dilation=m.dilation,
+            dimension_numbers=_conv_dims(m.format),
+            feature_group_count=m.n_group,
+            preferred_element_type=jnp.int32)
+        ws = self.weight_scale.reshape(-1)
+        if m.format == "NCHW":
+            y = acc.astype(jnp.float32) * (xs * ws)[None, :, None, None]
+            if self.bias is not None:
+                y = y + self.bias[None, :, None, None]
+        else:
+            y = acc.astype(jnp.float32) * (xs * ws)[None, None, None, :]
+            if self.bias is not None:
+                y = y + self.bias[None, None, None, :]
+        return y, state
+
+
+def quantize(model: Module) -> Module:
+    """Post-training quantization of a materialized (eager) module tree —
+    the ``model.quantize()`` entry point (reference
+    ``Quantization.quantize``).  Returns a NEW module; the original is
+    untouched.  Linear/SpatialConvolution become int8; everything else is
+    kept (running on f32 activations exactly like the reference's mixed
+    graph)."""
+    model._ensure_init()
+
+    def convert(m: Module, params) -> Module:
+        if isinstance(m, Container):
+            out = copy.copy(m)
+            out.modules = [convert(c, params.get(str(i), {}))
+                           for i, c in enumerate(m.modules)]
+            return out
+        if isinstance(m, Linear):
+            return QuantizedLinear.from_linear(m, params)
+        if isinstance(m, SpatialConvolution) and type(m) is \
+                SpatialConvolution:
+            return QuantizedSpatialConvolution.from_conv(m, params)
+        return m
+
+    q = convert(model, model._params)
+
+    # rebuild eager params/state for the converted tree: quantized leaves
+    # carry their buffers on the object, so init() gives empty params there
+    # while untouched leaves keep their trained params
+    def rebuild(m: Module, params, state):
+        if isinstance(m, Container):
+            p, s = {}, {}
+            for i, c in enumerate(m.modules):
+                cp, cs = rebuild(c, params.get(str(i), {}),
+                                 state.get(str(i), {}))
+                p[str(i)], s[str(i)] = cp, cs
+            return p, s
+        if isinstance(m, (QuantizedLinear, QuantizedSpatialConvolution)):
+            return {}, {}
+        return params, state
+
+    q._params, q._state = rebuild(q, model._params, model._state)
+    q._grads = jax.tree_util.tree_map(jnp.zeros_like, q._params)
+    q.training = False
+    return q

@@ -317,15 +317,17 @@ def _conv2d(attrs, x, w):
     # w: HWIO (TF kernel layout)
     df = _data_format(attrs)
     strides = [int(s) for s in attrs.get("strides", [1, 1, 1, 1])]
+    dil = [int(d) for d in attrs.get("dilations", [1, 1, 1, 1])]
     pad = attrs.get("padding", b"SAME")
     pad = pad.decode() if isinstance(pad, bytes) else pad
     if df == "NHWC":
         dn = ("NHWC", "HWIO", "NHWC")
-        ws = (strides[1], strides[2])
+        ws, rd = (strides[1], strides[2]), (dil[1], dil[2])
     else:
         dn = ("NCHW", "HWIO", "NCHW")
-        ws = (strides[2], strides[3])
+        ws, rd = (strides[2], strides[3]), (dil[2], dil[3])
     return lax.conv_general_dilated(x, w, window_strides=ws, padding=pad,
+                                    rhs_dilation=rd,
                                     dimension_numbers=dn)
 
 
@@ -333,17 +335,19 @@ def _conv2d(attrs, x, w):
 def _depthwise_conv(attrs, x, w):
     df = _data_format(attrs)
     strides = [int(s) for s in attrs.get("strides", [1, 1, 1, 1])]
+    dil = [int(d) for d in attrs.get("dilations", [1, 1, 1, 1])]
     pad = attrs.get("padding", b"SAME")
     pad = pad.decode() if isinstance(pad, bytes) else pad
     H, W, C, M = w.shape
     w2 = jnp.reshape(jnp.transpose(w, (0, 1, 3, 2)), (H, W, 1, C * M))
     if df == "NHWC":
         dn = ("NHWC", "HWIO", "NHWC")
-        ws = (strides[1], strides[2])
+        ws, rd = (strides[1], strides[2]), (dil[1], dil[2])
     else:
         dn = ("NCHW", "HWIO", "NCHW")
-        ws = (strides[2], strides[3])
+        ws, rd = (strides[2], strides[3]), (dil[2], dil[3])
     return lax.conv_general_dilated(x, w2, window_strides=ws, padding=pad,
+                                    rhs_dilation=rd,
                                     dimension_numbers=dn,
                                     feature_group_count=C)
 
@@ -397,10 +401,14 @@ def _softmax_ce(attrs, logits, labels):
 
 # -------------------------------------------------------------- random ops
 def _op_key(attrs) -> jax.Array:
-    """Deterministic key from the node's seed attrs (imported graphs run
-    under jit with no rng plumbing; reference ``DL/nn/ops/RandomUniform``
-    similarly seeds from the node)."""
+    """Deterministic key from the node's seed attrs AND its graph name
+    (the executor injects ``_node_name``): TF graphs usually leave
+    seed/seed2 at 0, and identical keys would give every same-shape
+    random-init variable byte-identical weights (symmetric branches).
+    Reference ``DL/nn/ops/RandomUniform`` similarly seeds per node."""
+    import zlib
     s = int(attrs.get("seed", 0)) * 2654435761 + int(attrs.get("seed2", 0))
+    s ^= zlib.crc32(str(attrs.get("_node_name", "")).encode())
     return jax.random.PRNGKey(s & 0x7FFFFFFF)
 
 

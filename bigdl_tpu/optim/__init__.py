@@ -3,7 +3,7 @@ training loops (reference ``DL/optim/`` + ``DL/parameters/``)."""
 
 from bigdl_tpu.optim.optim_method import (
     OptimMethod, SGD, Adam, ParallelAdam, Adagrad, Adadelta, Adamax,
-    RMSprop, Ftrl,
+    RMSprop, Ftrl, LBFGS,
 )
 from bigdl_tpu.optim.schedules import (
     LearningRateSchedule, Default, Step, MultiStep, EpochStep, EpochDecay,

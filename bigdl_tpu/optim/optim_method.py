@@ -289,3 +289,192 @@ class Ftrl(OptimMethod):
         linear = tmap(lambda t: t[2], out,
                       is_leaf=lambda x: isinstance(x, tuple))
         return new_params, {"accum": accum, "linear": linear}
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS (reference ``DL/optim/LBFGS.scala`` 308 LoC +
+    ``LineSearch.scala`` lswolfe).
+
+    Two usage modes, mirroring the reference's two call patterns:
+
+    - as an ``OptimMethod`` inside the training loop: ``update`` applies
+      the two-loop recursion over a fixed-size (s, y) history kept in
+      ``opt_state`` as stacked buffers — fully jit-compatible, step size
+      ``lr`` (no line search: that needs loss re-evaluation, which the
+      stochastic step contract doesn't provide; the reference's
+      minibatch LBFGS without lineSearch does exactly a fixed
+      ``learningRate`` step too, ``LBFGS.scala`` eval-free path);
+    - full-batch via :meth:`minimize` with Wolfe line search — the
+      deterministic-objective mode the reference pairs with
+      ``LineSearch.lswolfe``.
+    """
+
+    def __init__(self, learning_rate: float = 1.0, history: int = 10,
+                 weight_decay: float = 0.0,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, learning_rate_schedule, weight_decay)
+        self.history = history
+
+    def init_state(self, params):
+        from jax.flatten_util import ravel_pytree
+        flat, _ = ravel_pytree(params)
+        n, m = flat.shape[0], self.history
+        return {
+            "s": jnp.zeros((m, n)), "y": jnp.zeros((m, n)),
+            "rho": jnp.zeros((m,)),
+            "prev_flat": jnp.zeros((n,)), "prev_grad": jnp.zeros((n,)),
+            "count": jnp.zeros((), jnp.int32),   # steps taken
+            "pairs": jnp.zeros((), jnp.int32),   # (s, y) pairs pushed
+        }
+
+    def update(self, grads, params, opt_state, lr, step):
+        from jax.flatten_util import ravel_pytree
+        grads = self._apply_weight_decay(grads, params)
+        flat, unravel = ravel_pytree(params)
+        g, _ = ravel_pytree(grads)
+        m = self.history
+        st = opt_state
+
+        # push (s, y) from the previous step once we have a history
+        s_new = flat - st["prev_flat"]
+        y_new = g - st["prev_grad"]
+        ys = jnp.dot(s_new, y_new)
+        have_pair = (st["count"] > 0) & (ys > 1e-10)
+
+        def push(st):
+            rho_new = 1.0 / ys
+            return {**st,
+                    "s": jnp.roll(st["s"], -1, 0).at[-1].set(s_new),
+                    "y": jnp.roll(st["y"], -1, 0).at[-1].set(y_new),
+                    "rho": jnp.roll(st["rho"], -1).at[-1].set(rho_new),
+                    "pairs": st["pairs"] + 1}
+
+        st = jax.lax.cond(have_pair, push, lambda s: s, st)
+        # count PUSHED pairs, not steps: a rejected first pair (curvature
+        # s.y <= 0 under minibatch noise) must leave the direction as the
+        # raw gradient, not a zero-history product that freezes params
+        n_pairs = jnp.minimum(st["pairs"], m)
+
+        # two-loop recursion over the (ring-ordered) history
+        def bwd(i, carry):
+            q, alphas = carry
+            ix = m - 1 - i
+            valid = i < n_pairs
+            alpha = jnp.where(valid, st["rho"][ix]
+                              * jnp.dot(st["s"][ix], q), 0.0)
+            q = q - alpha * st["y"][ix]
+            return q, alphas.at[ix].set(alpha)
+
+        q, alphas = jax.lax.fori_loop(0, m, bwd,
+                                      (g, jnp.zeros((m,))))
+        # initial Hessian scaling gamma = s·y / y·y of newest pair
+        y_last = st["y"][-1]
+        s_last = st["s"][-1]
+        yy = jnp.dot(y_last, y_last)
+        gamma = jnp.where(n_pairs > 0,
+                          jnp.dot(s_last, y_last) / jnp.maximum(yy, 1e-10),
+                          1.0)
+        r = gamma * q
+
+        def fwd(i, r):
+            valid = i < n_pairs
+            start = m - n_pairs
+            ix = start + i
+            beta = jnp.where(valid, st["rho"][ix]
+                             * jnp.dot(st["y"][ix], r), 0.0)
+            return r + jnp.where(valid, (alphas[ix] - beta), 0.0) \
+                * st["s"][ix]
+
+        r = jax.lax.fori_loop(0, m, fwd, r)
+
+        new_flat = flat - lr * r
+        new_state = {**st, "prev_flat": flat, "prev_grad": g,
+                     "count": st["count"] + 1}
+        return unravel(new_flat), new_state
+
+    # ------------------------------------------------- full-batch driver
+    def minimize(self, feval, params, max_iter: int = 100,
+                 tol_grad: float = 1e-5, c1: float = 1e-4, c2: float = 0.9,
+                 max_ls: int = 20):
+        """Deterministic full-batch L-BFGS with Wolfe line search
+        (reference ``LineSearch.scala`` lswolfe conditions).  ``feval`` is
+        ``params -> (loss, grads)`` (e.g. ``jax.value_and_grad`` of the
+        objective).  Returns (params, final_loss, n_iter)."""
+        from jax.flatten_util import ravel_pytree
+
+        flat, unravel = ravel_pytree(params)
+        fe = lambda x: feval(unravel(x))
+
+        loss, grads = fe(flat)
+        g, _ = ravel_pytree(grads)
+        s_hist, y_hist, rho_hist = [], [], []
+        it = 0
+        for it in range(1, max_iter + 1):
+            if float(jnp.max(jnp.abs(g))) < tol_grad:
+                break
+            # two-loop on python history (host loop; feval jit'd by caller)
+            q = g
+            alphas = []
+            for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
+                                 reversed(rho_hist)):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if s_hist:
+                gamma = (jnp.dot(s_hist[-1], y_hist[-1])
+                         / jnp.maximum(jnp.dot(y_hist[-1], y_hist[-1]),
+                                       1e-10))
+            else:
+                gamma = 1.0
+            r = gamma * q
+            for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
+                                      reversed(alphas)):
+                b = rho * jnp.dot(y, r)
+                r = r + (a - b) * s
+            d = -r
+
+            # Wolfe line search
+            gtd = float(jnp.dot(g, d))
+            if gtd > -1e-12:   # not a descent direction: reset
+                d = -g
+                gtd = float(jnp.dot(g, d))
+                s_hist, y_hist, rho_hist = [], [], []
+            t = 1.0
+            f0 = float(loss)
+            ok = False
+            best_t, best_f = 0.0, f0
+            for _ in range(max_ls):
+                loss_t, grads_t = fe(flat + t * d)
+                f_t = float(loss_t)
+                if f_t < best_f:
+                    best_t, best_f = t, f_t
+                g_t, _ = ravel_pytree(grads_t)
+                if f_t > f0 + c1 * t * gtd:
+                    t *= 0.5          # Armijo failed: backtrack
+                elif float(jnp.dot(g_t, d)) < c2 * gtd:
+                    t = min(t * 2.1, 1e4)  # curvature failed: extend
+                else:
+                    ok = True
+                    break
+            if not ok:
+                # reference lswolfe falls back to the best evaluated point
+                # rather than committing an unevaluated step size
+                if best_t == 0.0:
+                    break  # no evaluated step improved: converged/stuck
+                t = best_t
+            new_flat = flat + t * d
+            loss_n, grads_n = fe(new_flat)
+            g_n, _ = ravel_pytree(grads_n)
+            s_new = new_flat - flat
+            y_new = g_n - g
+            ys = float(jnp.dot(s_new, y_new))
+            if ys > 1e-10:
+                s_hist.append(s_new)
+                y_hist.append(y_new)
+                rho_hist.append(1.0 / ys)
+                if len(s_hist) > self.history:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+                    rho_hist.pop(0)
+            flat, loss, g = new_flat, loss_n, g_n
+        return unravel(flat), float(loss), it

@@ -1,0 +1,62 @@
+"""Child process for the 2-process multi-host DistriOptimizer test.
+
+Usage: python mh_train_child.py <process_id> <coordinator_port>
+Prints ``RESULT pid loss val`` on success.  Run by
+``tests/test_multihost_failure.py`` — the analog of the reference's
+local-mode-cluster distributed tests (SURVEY §4) for real multi-process
+paths (``_make_global``, DistributedDataSet sharding, sharded eval,
+process-0-only checkpointing).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+ckpt_dir = sys.argv[3] if len(sys.argv) > 3 else None
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=pid)
+
+import numpy as np
+from jax.sharding import Mesh
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.dataset import SampleToMiniBatch
+from bigdl_tpu.dataset.dataset import DistributedDataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.engine import Engine
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8
+
+# identical global dataset on every host; DistributedDataSet shards it
+rng = np.random.RandomState(0)
+centers = rng.randn(3, 8) * 4.0
+y = rng.randint(0, 3, 256)
+x = (centers[y] + rng.randn(256, 8)).astype(np.float32)
+samples = [Sample(x[i], np.int32(y[i])) for i in range(256)]
+
+train = DistributedDataSet(samples) >> SampleToMiniBatch(16)  # local 16
+val = DistributedDataSet(samples) >> SampleToMiniBatch(16)
+
+mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+Engine.set_mesh(mesh)
+model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3),
+                      nn.LogSoftMax())
+opt = (optim.DistriOptimizer(model, train, nn.ClassNLLCriterion(),
+                             mesh=mesh, parameter_sharding=True)
+       .set_optim_method(optim.SGD(learning_rate=0.1, momentum=0.9,
+                                   dampening=0.0))
+       .set_end_when(optim.max_epoch(4))
+       .set_validation(optim.every_epoch(), val, [optim.Top1Accuracy()]))
+if ckpt_dir:
+    opt.set_checkpoint(ckpt_dir, optim.every_epoch())
+opt.optimize()
+print(f"RESULT {pid} {opt.state['loss']:.6f} "
+      f"{opt.state.get('score', float('nan')):.6f}", flush=True)

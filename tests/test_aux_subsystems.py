@@ -1,0 +1,271 @@
+"""Aux subsystems: LBFGS+LineSearch, per-layer profiling, unified config,
+TF control-flow (Switch/Merge) import.
+
+Reference analogs: ``DL/optim/LBFGS.scala``+``LineSearch.scala``,
+``AbstractModule.getTimes`` (``AbstractModule.scala:254-287``),
+the ``bigdl.*`` property soup (``Engine.scala:45-47``), and the
+DynamicGraph ``Scheduler`` (``nn/Scheduler.scala:104-145``).
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn, optim
+
+
+class TestLBFGS:
+    def test_minimize_rosenbrock(self):
+        def rosen(p):
+            x, y = p["x"], p["y"]
+            return (1 - x) ** 2 + 100.0 * (y - x * x) ** 2
+
+        feval = jax.jit(jax.value_and_grad(rosen))
+        p0 = {"x": jnp.asarray(-1.2), "y": jnp.asarray(1.0)}
+        p, loss, it = optim.LBFGS(history=10).minimize(feval, p0,
+                                                       max_iter=100)
+        assert loss < 1e-8
+        np.testing.assert_allclose(float(p["x"]), 1.0, atol=1e-3)
+
+    def test_update_contract_under_jit(self):
+        A = jnp.asarray(np.diag([1.0, 10.0, 100.0]))
+
+        def q(p):
+            return 0.5 * p["w"] @ A @ p["w"]
+
+        lb = optim.LBFGS(history=5)
+        params = {"w": jnp.asarray([1.0, 1.0, 1.0])}
+        st = lb.init_state(params)
+        vg = jax.value_and_grad(q)
+        upd = jax.jit(lb.update)
+        for i in range(50):
+            _, g = vg(params)
+            params, st = upd(g, params, st, 0.5, i)
+        assert float(q(params)) < 1e-6
+
+    def test_trains_via_optimizer(self):
+        # full-batch logistic regression through the normal Optimizer API
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 4).astype(np.float32)
+        w_true = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+        y = (x @ w_true > 0).astype(np.int32)
+        from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+        from bigdl_tpu.dataset.sample import Sample
+        samples = [Sample(x[i], y[i]) for i in range(128)]
+        model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+        opt = (optim.LocalOptimizer(
+                   model, DataSet.array(samples) >> SampleToMiniBatch(128),
+                   nn.ClassNLLCriterion())
+               .set_optim_method(optim.LBFGS(learning_rate=0.5))
+               .set_end_when(optim.max_epoch(30)))
+        opt.optimize()
+        model.training = False
+        acc = (np.argmax(np.asarray(model.forward(x)), -1) == y).mean()
+        assert acc > 0.95, acc
+
+
+class TestProfiling:
+    def test_get_times_per_layer(self):
+        from bigdl_tpu.utils.profiling import format_times, get_times
+        m = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                          nn.Linear(128, 8), nn.LogSoftMax())
+        m.initialize()
+        x = jnp.ones((16, 64))
+        times = get_times(m, x, repeats=2)
+        names = [t.name for t in times]
+        # one row per leaf (execution order) + total
+        assert sum("Linear" in n for n in names) == 2
+        assert all(t.forward_s >= 0 for t in times)
+        table = format_times(times)
+        assert "fwd(ms)" in table and "Linear" in table
+
+    def test_profile_step_writes_trace(self, tmp_path):
+        from bigdl_tpu.utils.profiling import profile_step
+        f = jax.jit(lambda x: jnp.sum(x * x))
+        out = profile_step(f, jnp.ones((128, 128)),
+                           log_dir=str(tmp_path), steps=2)
+        assert np.isfinite(float(out))
+        # a trace directory appeared
+        found = any("plugins" in root or f
+                    for root, _, f in os.walk(tmp_path))
+        assert found
+
+
+class TestConfig:
+    def test_env_overlay_and_configure(self, monkeypatch):
+        from bigdl_tpu.utils import config as C
+        C.reset_config()
+        monkeypatch.setenv("BIGDL_TPU_FAILURE_RETRY_TIMES", "7")
+        monkeypatch.setenv("BIGDL_TPU_COMPUTE_DTYPE", "bfloat16")
+        cfg = C.get_config()
+        assert cfg.failure_retry_times == 7
+        assert cfg.compute_dtype == "bfloat16"
+        C.configure(loader_workers=12)
+        assert C.get_config().loader_workers == 12
+        with pytest.raises(AttributeError):
+            C.configure(nonsense=1)
+        C.reset_config()
+
+    def test_engine_reads_config_default(self):
+        from bigdl_tpu.utils import config as C
+        C.reset_config()
+        from bigdl_tpu.engine import _EngineState
+        assert _EngineState().failure_retry_times == \
+            C.get_config().failure_retry_times
+
+
+class TestControlFlowImport:
+    def _cond_graph(self, tmp_path):
+        from bigdl_tpu.utils import protowire as pw
+
+        def node(name, op, inputs=(), **attrs):
+            body = pw.enc_str(1, name) + pw.enc_str(2, op)
+            for i in inputs:
+                body += pw.enc_str(3, i)
+            for k, v in attrs.items():
+                body += pw.enc_bytes(5, pw.enc_str(1, k)
+                                     + pw.enc_bytes(2, v))
+            return pw.enc_bytes(1, body)
+
+        def scalar_const(v):
+            t = (pw.enc_varint(1, 1) + pw.enc_bytes(2, b"")
+                 + pw.enc_bytes(4, np.float32(v).tobytes()))
+            return pw.enc_bytes(8, t)
+
+        g = (node("x", "Placeholder")
+             + node("pred", "Placeholder")
+             + node("sw", "Switch", ["x", "pred"])
+             + node("two", "Const", value=scalar_const(2.0))
+             + node("ten", "Const", value=scalar_const(10.0))
+             + node("tb", "Mul", ["sw:1", "two"])
+             + node("fb", "Add", ["sw:0", "ten"])
+             + node("merged", "Merge", ["fb", "tb"])
+             + node("out", "Identity", ["merged"]))
+        p = str(tmp_path / "cond.pb")
+        open(p, "wb").write(g)
+        return p
+
+    def test_cond_selects_by_predicate(self, tmp_path):
+        from bigdl_tpu.interop import load_tf_graph
+        m = load_tf_graph(self._cond_graph(tmp_path),
+                          inputs=["x", "pred"], outputs=["out"])
+        x = np.array([1.0, 2.0], np.float32)
+        t, _ = m.apply({}, {}, {"x": x, "pred": np.array(True)})
+        f, _ = m.apply({}, {}, {"x": x, "pred": np.array(False)})
+        np.testing.assert_allclose(np.asarray(t), x * 2)
+        np.testing.assert_allclose(np.asarray(f), x + 10)
+
+    def test_cond_with_traced_predicate_under_jit(self, tmp_path):
+        from bigdl_tpu.interop import load_tf_graph
+        m = load_tf_graph(self._cond_graph(tmp_path),
+                          inputs=["x", "pred"], outputs=["out"])
+        x = np.array([3.0], np.float32)
+        fn = jax.jit(lambda x, p: m.apply({}, {},
+                                          {"x": x, "pred": p})[0])
+        np.testing.assert_allclose(np.asarray(fn(x, True)), x * 2)
+        np.testing.assert_allclose(np.asarray(fn(x, False)), x + 10)
+
+    def test_loop_frames_rejected(self, tmp_path):
+        from bigdl_tpu.interop import load_tf_graph
+        from bigdl_tpu.utils import protowire as pw
+        g = (pw.enc_bytes(1, pw.enc_str(1, "x")
+                          + pw.enc_str(2, "Placeholder"))
+             + pw.enc_bytes(1, pw.enc_str(1, "e") + pw.enc_str(2, "Enter")
+                            + pw.enc_str(3, "x")))
+        p = str(tmp_path / "loop.pb")
+        open(p, "wb").write(g)
+        m = load_tf_graph(p, inputs=["x"], outputs=["e"])
+        with pytest.raises(NotImplementedError, match="while-loop"):
+            m.forward(np.zeros((1,), np.float32))
+
+
+class TestAuxReviewFixes:
+    """Regressions for the round-2 aux review findings."""
+
+    def test_lbfgs_survives_rejected_first_pair(self):
+        # first (s, y) pair violates curvature (crafted gradient flip);
+        # the optimizer must keep moving (used to freeze forever)
+        lb = optim.LBFGS(history=4, learning_rate=0.1)
+        params = {"w": jnp.asarray([1.0, -1.0, 2.0])}
+        st = lb.init_state(params)
+        grads = [jnp.asarray([2.0, 2.0, 2.0]),    # step 0
+                 jnp.asarray([4.0, 4.0, 4.0]),    # s.y < 0 vs step 0 dir
+                 jnp.asarray([1.0, 1.0, 1.0]),
+                 jnp.asarray([0.5, 0.5, 0.5])]
+        prev = params["w"]
+        for i, g in enumerate(grads):
+            params, st = lb.update({"w": g}, params, st, 0.1, i)
+        assert not np.allclose(np.asarray(params["w"]),
+                               np.asarray(prev)), "LBFGS froze"
+        assert np.isfinite(np.asarray(params["w"])).all()
+
+    def test_lbfgs_minimize_no_unevaluated_step(self):
+        # a badly scaled objective where curvature keeps failing must not
+        # commit an unevaluated exploding step
+        def f(p):
+            return jnp.sum(jnp.abs(p["w"]) ** 1.5)
+
+        feval = jax.value_and_grad(f)
+        p0 = {"w": jnp.asarray([2.0, -3.0])}
+        p, loss, _ = optim.LBFGS().minimize(feval, p0, max_iter=20,
+                                            max_ls=4)
+        assert np.isfinite(loss)
+        assert loss <= float(f(p0)) + 1e-9
+
+    def test_imported_random_inits_differ_per_node(self, tmp_path):
+        from bigdl_tpu.interop import load_tf_graph
+        from bigdl_tpu.utils import protowire as pw
+
+        def node(name, op, inputs=(), **attrs):
+            body = pw.enc_str(1, name) + pw.enc_str(2, op)
+            for i in inputs:
+                body += pw.enc_str(3, i)
+            for k, v in attrs.items():
+                body += pw.enc_bytes(5, pw.enc_str(1, k)
+                                     + pw.enc_bytes(2, v))
+            return pw.enc_bytes(1, body)
+
+        def shape_const(dims):
+            t = pw.enc_varint(1, 3)
+            shp = pw.enc_bytes(2, pw.enc_varint(1, len(dims)))
+            t += pw.enc_bytes(2, shp)
+            t += pw.enc_bytes(4, np.asarray(dims, np.int32).tobytes())
+            return pw.enc_bytes(8, t)
+
+        g = b""
+        for name in ("v1", "v2"):
+            g += node(f"{name}/shape", "Const", value=shape_const([4, 4]))
+            g += node(f"{name}/init", "TruncatedNormal",
+                      [f"{name}/shape"])
+            g += node(name, "VariableV2")
+            g += node(f"{name}/assign", "Assign", [name, f"{name}/init"])
+        g += node("out", "Add", ["v1", "v2"])
+        p = str(tmp_path / "g.pb")
+        open(p, "wb").write(g)
+        m = load_tf_graph(p, inputs=[], outputs=["out"])
+        v1, v2 = np.asarray(m._var_init["v1"]), np.asarray(m._var_init["v2"])
+        assert v1.shape == (4, 4)
+        assert not np.allclose(v1, v2), "same-shape inits byte-identical"
+
+    def test_dilated_conv2d_attr_respected(self):
+        from bigdl_tpu.ops import get_op
+        x = np.random.RandomState(0).randn(1, 8, 8, 1).astype(np.float32)
+        w = np.random.RandomState(1).randn(3, 3, 1, 1).astype(np.float32)
+        conv = get_op("Conv2D")
+        base = conv({"strides": [1, 1, 1, 1], "padding": b"VALID"}, x, w)
+        dil = conv({"strides": [1, 1, 1, 1], "padding": b"VALID",
+                    "dilations": [1, 2, 2, 1]}, x, w)
+        assert base.shape == (1, 6, 6, 1)
+        assert dil.shape == (1, 4, 4, 1)  # effective kernel 5x5
+
+    def test_convert_cli_rejects_tf_to_bigdl_before_load(self, tmp_path):
+        from bigdl_tpu.interop.convert_model import main as convert
+        with pytest.raises(SystemExit):
+            convert(["--from", "tensorflow", "--to", "bigdl",
+                     "--input", str(tmp_path / "missing.pb"),
+                     "--output", str(tmp_path / "x.bigdl"),
+                     "--inputs", "a", "--outputs", "b"])
