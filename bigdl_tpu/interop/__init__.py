@@ -9,3 +9,8 @@ from bigdl_tpu.interop.bigdl_format import (
     load_bigdl_module, save_bigdl_module, decode_bigdl_module,
 )
 from bigdl_tpu.interop.tf_format import load_tf_graph
+from bigdl_tpu.interop.caffe_format import load_caffe_model
+from bigdl_tpu.interop.torch_format import load_t7, save_t7
+from bigdl_tpu.interop.keras_format import (
+    load_keras_json, set_keras_weights, load_keras_hdf5_weights,
+)

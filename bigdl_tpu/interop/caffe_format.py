@@ -1,0 +1,307 @@
+"""Caffe model importer (prototxt + caffemodel).
+
+Reference: ``DL/utils/caffe/CaffeLoader.scala:57,85-104`` +
+``LayerConverter.scala`` (new-format ``layer``) /
+``V1LayerConverter.scala`` — prototxt defines the net topology, the
+binary caffemodel carries per-layer weight blobs matched by layer name;
+the loader builds a BigDL ``Graph`` and offers ``customizedConverters``
+for unknown layer types.
+
+TPU redesign: the generated ``caffe/Caffe.java`` protos (the bulk of the
+reference's 187k generated LoC) are replaced by the generic wire codec
+(``utils/protowire``) for the caffemodel and the text-proto parser (from
+``interop/tf_format``) for the prototxt; converted layers are the native
+functional modules assembled into ``nn.Graph``.
+
+Caffe proto field numbers used (from caffe.proto):
+  NetParameter: name=1, input=3, input_dim=4, input_shape=8, layer=100
+  LayerParameter: name=1, type=2, bottom=3, top=4, blobs=7,
+    convolution_param=106, inner_product_param=117, pooling_param=121,
+    lrn_param=118, dropout_param=108, concat_param=104,
+    eltwise_param=110, batch_norm_param=139, reshape_param=133,
+    input_param=143
+  BlobProto: shape=7 {dim=1}, data=5 (packed float), num/chan/h/w=1..4
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.graph import Graph, Input, Node
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.interop.tf_format import _parse_textproto, _tokenize
+from bigdl_tpu.utils import protowire as pw
+
+
+# ---------------------------------------------------------------- decoding
+def _blob_to_array(data: bytes) -> np.ndarray:
+    m = pw.decode_message(data)
+    vals: List[float] = []
+    for v in m.get(5, []):
+        vals.extend(pw.unpack_packed(v, "float")
+                    if isinstance(v, bytes) else [pw.as_float(v)])
+    arr = np.asarray(vals, np.float32)
+    if 7 in m:  # BlobShape
+        sm = pw.decode_message(m[7][0])
+        dims = [pw.as_sint(d) for d in pw.ints(sm, 1)]
+        return arr.reshape(dims)
+    legacy = [pw.ints(m, f)[0] if f in m else 1 for f in (1, 2, 3, 4)]
+    if np.prod(legacy) == arr.size:
+        return arr.reshape(legacy)
+    return arr
+
+
+def _decode_caffemodel(data: bytes) -> Dict[str, List[np.ndarray]]:
+    """caffemodel → {layer name: [blobs]} (weights then bias)."""
+    net = pw.decode_message(data)
+    blobs: Dict[str, List[np.ndarray]] = {}
+    for lay in net.get(100, []):   # new format LayerParameter
+        lm = pw.decode_message(lay)
+        name = pw.as_str(lm[1][0])
+        if 7 in lm:
+            blobs[name] = [_blob_to_array(b) for b in lm[7]]
+    for lay in net.get(2, []):     # V1LayerParameter fallback
+        lm = pw.decode_message(lay)
+        if 4 in lm and 6 in lm:
+            blobs[pw.as_str(lm[4][0])] = [_blob_to_array(b)
+                                          for b in lm[6]]
+    return blobs
+
+
+def _parse_prototxt(text: str) -> dict:
+    root = _parse_textproto(_tokenize(text))
+
+    def dec(v):
+        return v.decode() if isinstance(v, bytes) else v
+
+    layers = []
+    for key in ("layer", "layers"):
+        for l in root.get(key, []):
+            p: dict = {k: v for k, v in l.items()}
+            layers.append({
+                "name": dec(p["name"][0]),
+                "type": dec(p["type"][0]),
+                "bottom": [dec(b) for b in p.get("bottom", [])],
+                "top": [dec(t) for t in p.get("top", [])],
+                "params": p,
+            })
+    return {
+        "name": dec(root.get("name", [b""])[0]),
+        "inputs": [dec(i) for i in root.get("input", [])],
+        "input_dims": [int(d) for d in root.get("input_dim", [])],
+        "layers": layers,
+    }
+
+
+def _pick(p: dict, key: str, default=None):
+    v = p.get(key)
+    if not v:
+        return default
+    x = v[0]
+    return x.decode() if isinstance(x, bytes) else x
+
+
+# --------------------------------------------------------------- converters
+def _conv_module(name, cp, blobs):
+    num_out = int(_pick(cp, "num_output"))
+    kh = int(_pick(cp, "kernel_h", _pick(cp, "kernel_size", 1)))
+    kw = int(_pick(cp, "kernel_w", _pick(cp, "kernel_size", 1)))
+    sh = int(_pick(cp, "stride_h", _pick(cp, "stride", 1)))
+    sw = int(_pick(cp, "stride_w", _pick(cp, "stride", 1)))
+    ph = int(_pick(cp, "pad_h", _pick(cp, "pad", 0)))
+    pw_ = int(_pick(cp, "pad_w", _pick(cp, "pad", 0)))
+    group = int(_pick(cp, "group", 1))
+    dil = int(_pick(cp, "dilation", 1))
+    bias = bool(_pick(cp, "bias_term", True))
+    w = blobs[0]
+    n_in = w.shape[1] * group
+    m = nn.SpatialConvolution(n_in, num_out, kw, kh, sw, sh, pw_, ph,
+                              n_group=group, with_bias=bias,
+                              dilation_w=dil, dilation_h=dil, name=name)
+    params = {"weight": w.reshape(num_out, w.shape[1],
+                                  *w.shape[2:]).astype(np.float32)}
+    if bias and len(blobs) > 1:
+        params["bias"] = blobs[1].reshape(-1)
+    return m, params
+
+
+def _ip_module(name, ip, blobs):
+    num_out = int(_pick(ip, "num_output"))
+    bias = bool(_pick(ip, "bias_term", True))
+    w = blobs[0].reshape(num_out, -1)
+    # Caffe InnerProduct flattens its input implicitly
+    lin = nn.Linear(w.shape[1], num_out, with_bias=bias, name=name)
+    params = {"weight": w}
+    if bias and len(blobs) > 1:
+        params["bias"] = blobs[1].reshape(-1)
+    return nn.Sequential(nn.Flatten(), lin, name=name), {"1": params}
+
+
+def _pool_module(name, pp):
+    mode = _pick(pp, "pool", 0)
+    mode = {"MAX": 0, "AVE": 1}.get(mode, mode)
+    k = int(_pick(pp, "kernel_size", 2))
+    kh = int(_pick(pp, "kernel_h", k))
+    kw = int(_pick(pp, "kernel_w", k))
+    s = int(_pick(pp, "stride", 1))
+    sh = int(_pick(pp, "stride_h", s))
+    sw = int(_pick(pp, "stride_w", s))
+    p = int(_pick(pp, "pad", 0))
+    ph = int(_pick(pp, "pad_h", p))
+    pw_ = int(_pick(pp, "pad_w", p))
+    cls = nn.SpatialMaxPooling if int(mode) == 0 else nn.SpatialAveragePooling
+    # Caffe pooling uses ceil mode
+    return cls(kw, kh, sw, sh, pw_, ph, ceil_mode=True, name=name)
+
+
+def _convert_layer(layer: dict, blobs: List[np.ndarray],
+                   custom: Dict[str, Callable]):
+    t = layer["type"]
+    name = layer["name"]
+    p = layer["params"]
+    if t in custom:
+        return custom[t](layer, blobs), None
+    if t == "Convolution":
+        return _conv_module(name, p["convolution_param"][0], blobs)
+    if t == "InnerProduct":
+        return _ip_module(name, p["inner_product_param"][0], blobs)
+    if t == "Pooling":
+        return _pool_module(name, p["pooling_param"][0]), None
+    if t == "ReLU":
+        return nn.ReLU(name=name), None
+    if t == "TanH":
+        return nn.Tanh(name=name), None
+    if t == "Sigmoid":
+        return nn.Sigmoid(name=name), None
+    if t == "Softmax":
+        return nn.SoftMax(name=name), None
+    if t == "Dropout":
+        ratio = float(_pick(p.get("dropout_param", [{}])[0],
+                            "dropout_ratio", 0.5))
+        return nn.Dropout(ratio, name=name), None
+    if t == "LRN":
+        lp = p.get("lrn_param", [{}])[0]
+        return nn.SpatialCrossMapLRN(
+            size=int(_pick(lp, "local_size", 5)),
+            alpha=float(_pick(lp, "alpha", 1.0)),
+            beta=float(_pick(lp, "beta", 0.75)),
+            k=float(_pick(lp, "k", 1.0)), name=name), None
+    if t == "Concat":
+        cp = p.get("concat_param", [{}])[0]
+        return nn.JoinTable(int(_pick(cp, "axis", 1)), name=name), None
+    if t == "Eltwise":
+        ep = p.get("eltwise_param", [{}])[0]
+        op = _pick(ep, "operation", "SUM")
+        op = {0: "PROD", 1: "SUM", 2: "MAX"}.get(op, op)
+        if op == "SUM":
+            return nn.CAddTable(name=name), None
+        if op == "PROD":
+            return nn.CMulTable(name=name), None
+        return nn.CMaxTable(name=name), None
+    if t == "Flatten":
+        return nn.Flatten(name=name), None
+    if t == "BatchNorm":
+        bp = p.get("batch_norm_param", [{}])[0]
+        n = blobs[0].size if blobs else 0
+        m = nn.SpatialBatchNormalization(
+            n, eps=float(_pick(bp, "eps", 1e-5)), affine=False, name=name)
+        st = None
+        if blobs:
+            scale = blobs[2].reshape(-1)[0] if len(blobs) > 2 else 1.0
+            scale = 1.0 / scale if scale != 0 else 0.0
+            st = {"running_mean": blobs[0].reshape(-1) * scale,
+                  "running_var": blobs[1].reshape(-1) * scale}
+        return m, ("state", st)
+    if t in ("Input", "Data", "DummyData"):
+        return None, "input"   # registers its tops as graph inputs
+    if t in ("SoftmaxWithLoss", "Accuracy", "Silence"):
+        return None, "skip"    # training/diagnostic heads: dropped
+    raise NotImplementedError(
+        f"Caffe layer type {t!r} ({name}); pass custom={{'{t}': fn}} "
+        "(reference customizedConverters, CaffeLoader.scala:85)")
+
+
+# ------------------------------------------------------------------ loader
+def load_caffe_model(def_path: str, model_path: str,
+                     custom: Optional[Dict[str, Callable]] = None
+                     ) -> Module:
+    """prototxt + caffemodel → module graph with weights materialized
+    (reference ``Module.loadCaffeModel`` → ``CaffeLoader.scala:85-104``).
+
+    In-place layers (bottom == top, Caffe's ReLU idiom) chain naturally;
+    multi-input layers (Concat/Eltwise) become table ops on a Graph.
+    """
+    custom = custom or {}
+    with open(def_path) as f:
+        net = _parse_prototxt(f.read())
+    with open(model_path, "rb") as f:
+        blobs = _decode_caffemodel(f.read())
+
+    nodes: Dict[str, Node] = {}
+    inputs: List[Node] = []
+    for inp in net["inputs"]:
+        n = Input()
+        nodes[inp] = n
+        inputs.append(n)
+
+    weight_map = {}
+    state_map = {}
+    last: Optional[Node] = None
+    for layer in net["layers"]:
+        mod, extra = _convert_layer(layer, blobs.get(layer["name"], []),
+                                    custom)
+        if mod is None:
+            if extra == "input":
+                for top in layer["top"]:
+                    if top not in nodes:
+                        n = Input()
+                        nodes[top] = n
+                        inputs.append(n)
+            continue  # "skip": training/diagnostic head, dropped
+        bots = [nodes[b] for b in layer["bottom"] if b in nodes]
+        if not bots:
+            raise ValueError(f"layer {layer['name']} has unknown bottoms "
+                             f"{layer['bottom']}")
+        node = mod(bots if len(bots) > 1 else bots[0])
+        for top in layer["top"]:
+            nodes[top] = node
+        last = node
+        if isinstance(extra, dict):
+            weight_map[id(mod)] = extra
+        elif isinstance(extra, tuple) and extra[0] == "state":
+            state_map[id(mod)] = extra[1]
+        elif extra is not None:
+            weight_map[id(mod)] = extra
+
+    out_node = last
+    graph = Graph(inputs, [out_node], name=net["name"] or "CaffeNet")
+    graph.initialize()
+
+    # install converted weights: params are keyed by node order
+    import jax
+    import jax.numpy as jnp
+    params = jax.tree_util.tree_map(np.asarray, graph._params)
+    gstate = jax.tree_util.tree_map(np.asarray, graph._state)
+    for i, (n, key) in enumerate(zip(graph._order, graph._param_keys)):
+        mod = n.module
+        w = weight_map.get(id(mod))
+        if w is not None:
+            _merge(params[key], w)
+        st = state_map.get(id(mod))
+        if st is not None and key in gstate:
+            _merge(gstate[key], st)
+    graph._params = jax.tree_util.tree_map(jnp.asarray, params)
+    graph._state = jax.tree_util.tree_map(jnp.asarray, gstate)
+    graph._grads = jax.tree_util.tree_map(jnp.zeros_like, graph._params)
+    return graph
+
+
+def _merge(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict):
+            _merge(dst.setdefault(k, {}), v)
+        else:
+            dst[k] = np.asarray(v, np.float32)

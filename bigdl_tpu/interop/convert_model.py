@@ -3,10 +3,9 @@
 Reference: ``DL/utils/ConvertModel.scala:24-46`` —
 ``--from {bigdl,caffe,torch,tensorflow} --to {bigdl,...}``.  Supported
 conversion: ``bigdl → bigdl`` (re-serialize, e.g. to normalize storage
-layout).  ``tensorflow`` sources load and execute natively as
-``TFGraphModule`` (no structural conversion to the bigdl layer tree), so
-``tensorflow → bigdl`` is rejected up front — save an imported graph's
-weights with ``utils/checkpoint`` instead.
+layout).  TF/Caffe/Torch sources load and execute natively via
+``interop.load_tf_graph`` / ``load_caffe_model`` / ``load_t7`` — there is
+no structural conversion into the bigdl layer tree to re-serialize.
 
 Usage:
     python -m bigdl_tpu.interop.convert_model \
@@ -21,32 +20,21 @@ import argparse
 def main(argv=None):
     p = argparse.ArgumentParser(description="Convert models between formats")
     p.add_argument("--from", dest="src_fmt", required=True,
-                   choices=["bigdl", "tensorflow"])
+                   choices=["bigdl"],
+                   help="source format; tensorflow/caffe/torch models "
+                        "import via interop.load_tf_graph / "
+                        "load_caffe_model / load_t7 and execute natively "
+                        "(no structural conversion to re-serialize)")
     p.add_argument("--to", dest="dst_fmt", required=True,
                    choices=["bigdl"])
     p.add_argument("--input", required=True, help="source model file")
     p.add_argument("--output", required=True, help="destination file")
-    p.add_argument("--inputs", default=None,
-                   help="comma-separated TF input node names")
-    p.add_argument("--outputs", default=None,
-                   help="comma-separated TF output node names")
     args = p.parse_args(argv)
-
-    # validate the combination BEFORE any expensive load
-    if args.src_fmt == "tensorflow" and args.dst_fmt == "bigdl":
-        p.error(
-            "tensorflow->bigdl structural conversion is not supported: an "
-            "imported TF graph executes natively (TFGraphModule); load it "
-            "with interop.load_tf_graph and save its weights with "
-            "utils/checkpoint instead")
-    if args.src_fmt == "tensorflow" and not (args.inputs and args.outputs):
-        p.error("tensorflow source needs --inputs and --outputs")
 
     from bigdl_tpu.interop import load_bigdl_module, save_bigdl_module
 
     model = load_bigdl_module(args.input)
-    if args.dst_fmt == "bigdl":
-        save_bigdl_module(model, args.output)
+    save_bigdl_module(model, args.output)
     print(f"converted {args.input} ({args.src_fmt}) -> "
           f"{args.output} ({args.dst_fmt})")
 

@@ -1,0 +1,188 @@
+"""Keras 1.2 JSON definition importer.
+
+Reference: ``pyspark/bigdl/keras/converter.py`` — ``DefinitionLoader:289``
+maps a Keras-1.2.2 ``model.to_json()`` document onto BigDL layers;
+``WeightLoader:32`` pulls weights from the companion HDF5.
+
+TPU redesign: the JSON maps onto the deferred ``bigdl_tpu.keras``
+wrappers (which already reproduce the Keras-1.2 layer surface + shape
+inference), so the converter is a thin config translation.  HDF5 weight
+loading is gated on ``h5py`` being importable (not a baked dependency);
+``set_keras_weights`` applies a plain list of arrays in Keras order for
+environments without it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu import keras as K
+
+
+def _layer_from_config(entry: Dict[str, Any]):
+    cls = entry["class_name"]
+    cfg = entry.get("config", {})
+
+    def input_shape():
+        bis = cfg.get("batch_input_shape")
+        if bis:
+            return tuple(int(d) for d in bis[1:])
+        if cfg.get("input_dim"):
+            return (int(cfg["input_dim"]),)
+        return None
+
+    common = {"input_shape": input_shape(), "name": cfg.get("name")}
+    if cls == "Dense":
+        return K.Dense(int(cfg["output_dim"]),
+                       activation=cfg.get("activation"),
+                       bias=cfg.get("bias", True), **common)
+    if cls == "Activation":
+        return K.Activation(cfg["activation"], **common)
+    if cls == "Dropout":
+        return K.Dropout(float(cfg.get("p", 0.5)), **common)
+    if cls == "Flatten":
+        return K.Flatten(**common)
+    if cls == "Reshape":
+        return K.Reshape(tuple(cfg["target_shape"]), **common)
+    if cls == "Convolution2D":
+        return K.Convolution2D(
+            int(cfg["nb_filter"]), int(cfg["nb_row"]), int(cfg["nb_col"]),
+            activation=cfg.get("activation"),
+            border_mode=cfg.get("border_mode", "valid"),
+            subsample=tuple(cfg.get("subsample", (1, 1))),
+            dim_ordering=cfg.get("dim_ordering", "th"),
+            bias=cfg.get("bias", True), **common)
+    if cls == "Convolution1D":
+        return K.Convolution1D(
+            int(cfg["nb_filter"]), int(cfg["filter_length"]),
+            activation=cfg.get("activation"),
+            subsample_length=int(cfg.get("subsample_length", 1)), **common)
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        klass = K.MaxPooling2D if cls == "MaxPooling2D" \
+            else K.AveragePooling2D
+        return klass(pool_size=tuple(cfg.get("pool_size", (2, 2))),
+                     strides=(tuple(cfg["strides"])
+                              if cfg.get("strides") else None),
+                     border_mode=cfg.get("border_mode", "valid"),
+                     dim_ordering=cfg.get("dim_ordering", "th"), **common)
+    if cls == "GlobalAveragePooling2D":
+        return K.GlobalAveragePooling2D(
+            dim_ordering=cfg.get("dim_ordering", "th"), **common)
+    if cls == "GlobalMaxPooling2D":
+        return K.GlobalMaxPooling2D(
+            dim_ordering=cfg.get("dim_ordering", "th"), **common)
+    if cls == "ZeroPadding2D":
+        return K.ZeroPadding2D(tuple(cfg.get("padding", (1, 1))),
+                               dim_ordering=cfg.get("dim_ordering", "th"),
+                               **common)
+    if cls == "BatchNormalization":
+        return K.BatchNormalization(
+            epsilon=float(cfg.get("epsilon", 1e-3)),
+            momentum=float(cfg.get("momentum", 0.99)),
+            dim_ordering=cfg.get("dim_ordering", "th"), **common)
+    if cls == "Embedding":
+        return K.Embedding(int(cfg["input_dim"]), int(cfg["output_dim"]),
+                           input_length=cfg.get("input_length"), **common)
+    if cls in ("LSTM", "GRU", "SimpleRNN"):
+        klass = {"LSTM": K.LSTM, "GRU": K.GRU,
+                 "SimpleRNN": K.SimpleRNN}[cls]
+        return klass(int(cfg["output_dim"]),
+                     return_sequences=cfg.get("return_sequences", False),
+                     go_backwards=cfg.get("go_backwards", False), **common)
+    raise NotImplementedError(
+        f"Keras 1.2 layer {cls!r} is not mapped (reference "
+        "converter.py LAYER mapping)")
+
+
+def load_keras_json(json_str_or_path: str) -> "K.Sequential":
+    """Keras-1.2 ``model.to_json()`` → :class:`bigdl_tpu.keras.Sequential`
+    (reference ``DefinitionLoader.from_json_path``)."""
+    text = json_str_or_path
+    if not text.lstrip().startswith("{"):
+        with open(json_str_or_path) as f:
+            text = f.read()
+    doc = json.loads(text)
+    cls = doc.get("class_name")
+    if cls != "Sequential":
+        raise NotImplementedError(
+            f"Keras model class {cls!r}: only Sequential JSON is "
+            "supported (functional Model graphs: build with "
+            "bigdl_tpu.keras directly)")
+    model = K.Sequential()
+    for entry in doc.get("config", []):
+        model.add(_layer_from_config(entry))
+    return model
+
+
+def set_keras_weights(model: "K.Sequential",
+                      weights: List[np.ndarray]) -> None:
+    """Install a flat Keras-order weight list (each layer's
+    ``get_weights()`` concatenated) into the built core module
+    (reference ``WeightLoader``; Keras Dense stores W as (in, out) —
+    transposed into our (out, in))."""
+    import jax
+    import jax.numpy as jnp
+
+    core = model.core_module()
+    core._ensure_init()
+    params = jax.tree_util.tree_map(np.asarray, core._params)
+    w_ix = 0
+
+    def fill(p):
+        nonlocal w_ix
+        # dict of leaves for one layer: weight (+bias)
+        if "weight" in p:
+            w = np.asarray(weights[w_ix])
+            w_ix += 1
+            tgt = p["weight"]
+            if w.ndim == 2 and w.shape == tgt.shape[::-1]:
+                w = w.T               # Keras Dense (in,out) -> (out,in)
+            elif w.ndim == 4 and w.shape != tgt.shape:
+                # Keras th conv kernels are already (out,in,kh,kw);
+                # tf ordering (kh,kw,in,out) -> OIHW
+                w = np.transpose(w, (3, 2, 0, 1))
+            p["weight"] = w.reshape(tgt.shape)
+        if "bias" in p:
+            p["bias"] = np.asarray(weights[w_ix]).reshape(p["bias"].shape)
+            w_ix += 1
+
+    def walk(p):
+        if isinstance(p, dict) and ("weight" in p or "bias" in p):
+            fill(p)
+            return
+        if isinstance(p, dict):
+            for k in sorted(p.keys(), key=lambda s: (len(s), s)):
+                walk(p[k])
+
+    walk(params)
+    if w_ix != len(weights):
+        raise ValueError(f"consumed {w_ix} of {len(weights)} weight arrays")
+    core._params = jax.tree_util.tree_map(jnp.asarray, params)
+    model._params = core._params
+    model._mstate = core._state
+
+
+def load_keras_hdf5_weights(model: "K.Sequential", h5_path: str) -> None:
+    """Load weights from a Keras-1.2 HDF5 file (needs ``h5py``, which is
+    optional in this image)."""
+    try:
+        import h5py
+    except ImportError as e:
+        raise ImportError(
+            "h5py is not installed; extract the weight arrays yourself "
+            "and call set_keras_weights(model, arrays)") from e
+    arrays: List[np.ndarray] = []
+    with h5py.File(h5_path, "r") as f:
+        grp = f["model_weights"] if "model_weights" in f else f
+        names = [n.decode() if isinstance(n, bytes) else n
+                 for n in grp.attrs.get("layer_names", [])]
+        for lname in names:
+            g = grp[lname]
+            wn = [n.decode() if isinstance(n, bytes) else n
+                  for n in g.attrs.get("weight_names", [])]
+            for w in wn:
+                arrays.append(np.asarray(g[w]))
+    set_keras_weights(model, arrays)

@@ -603,7 +603,13 @@ class TFGraphModule(Module):
                     tags = _union_tags(args)
                     out = get_op(op)(
                         {**node["attrs"], "_node_name": nm}, *raw)
-                    values[nm] = _Tagged(out, tags) if tags else out
+                    if not tags:
+                        values[nm] = out
+                    elif isinstance(out, tuple):
+                        # tag each port so downstream `v[ix]` still works
+                        values[nm] = tuple(_Tagged(o, tags) for o in out)
+                    else:
+                        values[nm] = _Tagged(out, tags)
         outs = []
         for o in self.output_names:
             b, ix = _base_name(o)

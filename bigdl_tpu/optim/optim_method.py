@@ -443,6 +443,7 @@ class LBFGS(OptimMethod):
             f0 = float(loss)
             ok = False
             best_t, best_f = 0.0, f0
+            loss_t = grads_t = None
             for _ in range(max_ls):
                 loss_t, grads_t = fe(flat + t * d)
                 f_t = float(loss_t)
@@ -456,14 +457,18 @@ class LBFGS(OptimMethod):
                 else:
                     ok = True
                     break
-            if not ok:
+            if ok:
+                # the accepted point was just evaluated — reuse it
+                new_flat = flat + t * d
+                loss_n, grads_n = loss_t, grads_t
+            else:
                 # reference lswolfe falls back to the best evaluated point
                 # rather than committing an unevaluated step size
                 if best_t == 0.0:
                     break  # no evaluated step improved: converged/stuck
                 t = best_t
-            new_flat = flat + t * d
-            loss_n, grads_n = fe(new_flat)
+                new_flat = flat + t * d
+                loss_n, grads_n = fe(new_flat)
             g_n, _ = ravel_pytree(grads_n)
             s_new = new_flat - flat
             y_new = g_n - g

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tfgraph_util import attr_tensor, node, scalar_const, shape_const  # noqa: E501
 from bigdl_tpu import nn, optim
 
 
@@ -122,19 +123,7 @@ class TestControlFlowImport:
     def _cond_graph(self, tmp_path):
         from bigdl_tpu.utils import protowire as pw
 
-        def node(name, op, inputs=(), **attrs):
-            body = pw.enc_str(1, name) + pw.enc_str(2, op)
-            for i in inputs:
-                body += pw.enc_str(3, i)
-            for k, v in attrs.items():
-                body += pw.enc_bytes(5, pw.enc_str(1, k)
-                                     + pw.enc_bytes(2, v))
-            return pw.enc_bytes(1, body)
 
-        def scalar_const(v):
-            t = (pw.enc_varint(1, 1) + pw.enc_bytes(2, b"")
-                 + pw.enc_bytes(4, np.float32(v).tobytes()))
-            return pw.enc_bytes(8, t)
 
         g = (node("x", "Placeholder")
              + node("pred", "Placeholder")
@@ -220,21 +209,7 @@ class TestAuxReviewFixes:
         from bigdl_tpu.interop import load_tf_graph
         from bigdl_tpu.utils import protowire as pw
 
-        def node(name, op, inputs=(), **attrs):
-            body = pw.enc_str(1, name) + pw.enc_str(2, op)
-            for i in inputs:
-                body += pw.enc_str(3, i)
-            for k, v in attrs.items():
-                body += pw.enc_bytes(5, pw.enc_str(1, k)
-                                     + pw.enc_bytes(2, v))
-            return pw.enc_bytes(1, body)
 
-        def shape_const(dims):
-            t = pw.enc_varint(1, 3)
-            shp = pw.enc_bytes(2, pw.enc_varint(1, len(dims)))
-            t += pw.enc_bytes(2, shp)
-            t += pw.enc_bytes(4, np.asarray(dims, np.int32).tobytes())
-            return pw.enc_bytes(8, t)
 
         g = b""
         for name in ("v1", "v2"):

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tfgraph_util import attr_tensor, node, scalar_const, shape_const  # noqa: E501
 from bigdl_tpu import nn
 from bigdl_tpu.interop import (load_bigdl_module, load_tf_graph,
                                save_bigdl_module, decode_bigdl_module)
@@ -140,23 +141,7 @@ class TestTFImport:
         """Exercise the ops layer + pruning via a hand-built GraphDef."""
         from bigdl_tpu.utils import protowire as pw
 
-        def node(name, op, inputs=(), **attrs):
-            body = pw.enc_str(1, name) + pw.enc_str(2, op)
-            for i in inputs:
-                body += pw.enc_str(3, i)
-            for k, v in attrs.items():
-                body += pw.enc_bytes(5, pw.enc_str(1, k)
-                                     + pw.enc_bytes(2, v))
-            return pw.enc_bytes(1, body)
 
-        def attr_tensor(arr):
-            arr = np.asarray(arr, np.float32)
-            t = pw.enc_varint(1, 1)  # DT_FLOAT
-            shp = b"".join(pw.enc_bytes(2, pw.enc_varint(1, d))
-                           for d in arr.shape)
-            t += pw.enc_bytes(2, shp)
-            t += pw.enc_bytes(4, arr.tobytes())
-            return pw.enc_bytes(8, t)
 
         w = np.random.RandomState(0).randn(4, 3).astype(np.float32)
         g = (node("x", "Placeholder")
@@ -249,3 +234,160 @@ class TestInteropReviewFixes:
         x = np.zeros((2, 3), np.float32)
         with pytest.raises(NotImplementedError):
             op({"ellipsis_mask": 1}, x, [0, 0], [1, 1], [1, 1])
+
+
+REF_CAFFE = "/root/reference/spark/dl/src/test/resources/caffe"
+REF_TORCH = "/root/reference/spark/dl/src/test/resources/torch"
+
+
+class TestCaffeImport:
+    def test_reference_fixture_loads_and_runs(self):
+        if not os.path.exists(REF_CAFFE):
+            pytest.skip("reference resources unavailable")
+        from bigdl_tpu.interop import load_caffe_model
+        m = load_caffe_model(
+            os.path.join(REF_CAFFE, "test.prototxt"),
+            os.path.join(REF_CAFFE, "test.caffemodel"),
+            custom={"Dummy": lambda layer, blobs:
+                    nn.Identity(name=layer["name"])})
+        m.training = False
+        x = np.random.RandomState(0).rand(1, 3, 5, 5).astype(np.float32)
+        out = np.asarray(m.forward(x))
+        assert out.shape == (1, 2)
+        np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-5)
+
+    def test_weights_come_from_caffemodel(self):
+        if not os.path.exists(REF_CAFFE):
+            pytest.skip("reference resources unavailable")
+        from bigdl_tpu.interop import load_caffe_model
+        from bigdl_tpu.interop.caffe_format import _decode_caffemodel
+        m = load_caffe_model(
+            os.path.join(REF_CAFFE, "test.prototxt"),
+            os.path.join(REF_CAFFE, "test.caffemodel"),
+            custom={"Dummy": lambda layer, blobs: nn.Identity()})
+        blobs = _decode_caffemodel(
+            open(os.path.join(REF_CAFFE, "test.caffemodel"), "rb").read())
+        key0 = m._param_keys[0]
+        got = np.asarray(m._params[key0]["weight"])
+        np.testing.assert_allclose(got, blobs["conv"][0].reshape(got.shape),
+                                   atol=1e-6)
+
+    def test_unknown_layer_raises_without_custom(self):
+        if not os.path.exists(REF_CAFFE):
+            pytest.skip("reference resources unavailable")
+        from bigdl_tpu.interop import load_caffe_model
+        with pytest.raises(NotImplementedError, match="Dummy"):
+            load_caffe_model(os.path.join(REF_CAFFE, "test.prototxt"),
+                             os.path.join(REF_CAFFE, "test.caffemodel"))
+
+
+class TestTorchT7:
+    def test_reads_reference_image_tensors(self):
+        if not os.path.exists(REF_TORCH):
+            pytest.skip("reference resources unavailable")
+        from bigdl_tpu.interop import load_t7
+        import glob
+        files = sorted(glob.glob(os.path.join(REF_TORCH, "*.t7")))
+        assert files
+        arr = load_t7(files[0])
+        assert isinstance(arr, np.ndarray)
+        assert arr.shape == (3, 224, 224) and arr.dtype == np.float32
+        assert np.isfinite(arr).all()
+
+    def test_roundtrip_table_of_tensors(self, tmp_path):
+        from bigdl_tpu.interop import load_t7, save_t7
+        data = {"w": np.random.RandomState(0).rand(4, 3).astype(np.float32),
+                "ids": np.arange(5, dtype=np.int64),
+                "lr": 0.1, "tag": "oracle", "ok": True,
+                "seq": [1.0, 2.0]}
+        p = str(tmp_path / "x.t7")
+        save_t7(p, data)
+        back = load_t7(p)
+        np.testing.assert_allclose(back["w"], data["w"])
+        np.testing.assert_array_equal(back["ids"], data["ids"])
+        assert back["tag"] == "oracle" and back["ok"] is True
+        assert back["seq"] == [1, 2]
+
+
+class TestKerasJSON:
+    def _json(self):
+        import json
+        return json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Dense",
+                 "config": {"output_dim": 8, "activation": "relu",
+                            "batch_input_shape": [None, 4]}},
+                {"class_name": "Dropout", "config": {"p": 0.5}},
+                {"class_name": "Dense",
+                 "config": {"output_dim": 3, "activation": "softmax"}},
+            ]})
+
+    def test_definition_import_and_forward(self):
+        from bigdl_tpu.interop import load_keras_json
+        m = load_keras_json(self._json())
+        assert m.output_shape == (None, 3)
+        core = m.core_module()
+        core.training = False
+        out = np.asarray(core.forward(np.zeros((2, 4), np.float32)))
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+    def test_weight_install_keras_order(self):
+        from bigdl_tpu.interop import load_keras_json, set_keras_weights
+        m = load_keras_json(self._json())
+        rng = np.random.RandomState(0)
+        ws = [rng.rand(4, 8).astype(np.float32),   # Dense1 W (in,out)
+              rng.rand(8).astype(np.float32),
+              rng.rand(8, 3).astype(np.float32),
+              rng.rand(3).astype(np.float32)]
+        set_keras_weights(m, ws)
+        x = rng.rand(2, 4).astype(np.float32)
+        core = m.core_module()
+        core.training = False
+        out = np.asarray(core.forward(x))
+        h = np.maximum(x @ ws[0] + ws[1], 0)
+        logits = h @ ws[2] + ws[3]
+        ref = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_unknown_layer_reports(self):
+        from bigdl_tpu.interop import load_keras_json
+        import json
+        doc = json.dumps({"class_name": "Sequential", "config": [
+            {"class_name": "Lambda", "config": {}}]})
+        with pytest.raises(NotImplementedError, match="Lambda"):
+            load_keras_json(doc)
+
+
+class TestReviewFixesE:
+    def test_multi_output_op_inside_switch_branch(self, tmp_path):
+        # Unpack (tuple-output) downstream of Switch: port indexing must
+        # survive the branch tagging
+        from bigdl_tpu.interop import load_tf_graph
+        g = (node("x", "Placeholder")
+             + node("pred", "Placeholder")
+             + node("sw", "Switch", ["x", "pred"])
+             + node("up", "Unpack", ["sw:1"])
+             + node("second", "Identity", ["up:1"]))
+        p = str(tmp_path / "g.pb")
+        open(p, "wb").write(g)
+        m = load_tf_graph(p, inputs=["x", "pred"], outputs=["second"])
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        out, _ = m.apply({}, {}, {"x": x, "pred": np.array(True)})
+        np.testing.assert_allclose(np.asarray(out), x[1])
+
+    def test_t7_int32_roundtrip(self, tmp_path):
+        from bigdl_tpu.interop import load_t7, save_t7
+        p = str(tmp_path / "i.t7")
+        ids = np.arange(7, dtype=np.int32)
+        save_t7(p, ids)
+        back = load_t7(p)
+        assert back.dtype == np.int32
+        np.testing.assert_array_equal(back, ids)
+
+    def test_caffe_dilation_honored(self):
+        from bigdl_tpu.interop.caffe_format import _conv_module
+        cp = {"num_output": [2], "kernel_size": [3], "dilation": [2]}
+        blobs = [np.zeros((2, 3, 3, 3), np.float32)]
+        m, _ = _conv_module("c", cp, blobs)
+        assert m.dilation == (2, 2)

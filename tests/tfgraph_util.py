@@ -1,0 +1,43 @@
+"""Shared helper: hand-build binary GraphDef fixtures with protowire.
+
+Used by test_interop.py and test_aux_subsystems.py (one copy; the wire
+layout of NodeDef/TensorProto lives here only).
+"""
+
+import numpy as np
+
+from bigdl_tpu.utils import protowire as pw
+
+
+def node(name, op, inputs=(), **attrs):
+    body = pw.enc_str(1, name) + pw.enc_str(2, op)
+    for i in inputs:
+        body += pw.enc_str(3, i)
+    for k, v in attrs.items():
+        body += pw.enc_bytes(5, pw.enc_str(1, k) + pw.enc_bytes(2, v))
+    return pw.enc_bytes(1, body)
+
+
+def attr_tensor(arr):
+    """float32 TensorProto attr payload."""
+    arr = np.asarray(arr, np.float32)
+    t = pw.enc_varint(1, 1)  # DT_FLOAT
+    shp = b"".join(pw.enc_bytes(2, pw.enc_varint(1, d)) for d in arr.shape)
+    t += pw.enc_bytes(2, shp)
+    t += pw.enc_bytes(4, arr.tobytes())
+    return pw.enc_bytes(8, t)
+
+
+def scalar_const(v):
+    t = (pw.enc_varint(1, 1) + pw.enc_bytes(2, b"")
+         + pw.enc_bytes(4, np.float32(v).tobytes()))
+    return pw.enc_bytes(8, t)
+
+
+def shape_const(dims):
+    """int32 shape-vector TensorProto attr payload."""
+    t = pw.enc_varint(1, 3)  # DT_INT32
+    shp = pw.enc_bytes(2, pw.enc_varint(1, len(dims)))
+    t += pw.enc_bytes(2, shp)
+    t += pw.enc_bytes(4, np.asarray(dims, np.int32).tobytes())
+    return pw.enc_bytes(8, t)
