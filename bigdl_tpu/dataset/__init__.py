@@ -16,4 +16,7 @@ from bigdl_tpu.dataset import mnist
 from bigdl_tpu.dataset import cifar
 from bigdl_tpu.dataset import text
 from bigdl_tpu.dataset import tfrecord
+from bigdl_tpu.dataset import seqfile
+from bigdl_tpu.dataset import movielens
+from bigdl_tpu.dataset import news20
 from bigdl_tpu.dataset.prefetch import MTSampleToMiniBatch

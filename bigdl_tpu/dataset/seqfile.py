@@ -1,0 +1,193 @@
+"""Hadoop SequenceFile reader/writer (uncompressed, Text/Bytes records).
+
+Reference: the ImageNet ingestion pipeline —
+``DL/models/utils/ImageNetSeqFileGenerator.scala`` packs images into
+sequence files via ``BGRImgToLocalSeqFile`` (key = ``Text``
+``"<name>\\n<label>"`` or ``"<label>"``, value = ``Text`` image bytes),
+and training reads them back with ``LocalSeqFileToBytes``.  The TPU build
+reads/writes the same container so reference-generated datasets feed it
+unchanged — without Hadoop: the uncompressed SequenceFile layout is
+simple enough to speak directly.
+
+Format (all big-endian):
+  header:  b"SEQ" + version byte (6), key class (Hadoop Text string),
+           value class, bool compressed, bool blockCompressed,
+           metadata count (int32) + pairs, 16-byte sync marker
+  record:  recordLen int32, keyLen int32, key bytes, value bytes;
+           recordLen == -1 → 16-byte sync marker follows
+  Text payloads start with a Hadoop VInt length.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_VERSION = 6
+TEXT = "org.apache.hadoop.io.Text"
+BYTES_WRITABLE = "org.apache.hadoop.io.BytesWritable"
+
+
+# ----------------------------------------------------------- hadoop VInt
+def read_vint(buf: bytes, pos: int) -> Tuple[int, int]:
+    """Hadoop WritableUtils.readVInt → (value, new_pos)."""
+    first = struct.unpack_from("b", buf, pos)[0]
+    pos += 1
+    if first >= -112:
+        return first, pos
+    if first >= -120:
+        n = -(first + 112)
+        neg = False
+    else:
+        n = -(first + 120)
+        neg = True
+    v = 0
+    for _ in range(n):
+        v = (v << 8) | buf[pos]
+        pos += 1
+    return (~v if neg else v), pos
+
+
+def write_vint(v: int) -> bytes:
+    if -112 <= v <= 127:
+        return struct.pack("b", v)
+    neg = v < 0
+    if neg:
+        v = ~v
+    n = (v.bit_length() + 7) // 8
+    first = (-112 - n) if not neg else (-120 - n)
+    return struct.pack("b", first) + v.to_bytes(n, "big")
+
+
+def _hadoop_string(s: str) -> bytes:
+    b = s.encode()
+    return write_vint(len(b)) + b
+
+
+def _read_hadoop_string(f) -> str:
+    # VInt length then bytes; VInt is at most 5 bytes here
+    head = f.read(1)
+    first = struct.unpack("b", head)[0]
+    if first >= -112:
+        n = first
+    else:
+        ln = -(first + 112) if first >= -120 else -(first + 120)
+        n = int.from_bytes(f.read(ln), "big")
+    return f.read(n).decode()
+
+
+def _decode_text(payload: bytes) -> bytes:
+    """Text serialization = VInt byte-length + utf8 bytes."""
+    n, pos = read_vint(payload, 0)
+    return payload[pos:pos + n]
+
+
+def _decode_bytes_writable(payload: bytes) -> bytes:
+    (n,) = struct.unpack_from(">i", payload, 0)
+    return payload[4:4 + n]
+
+
+# ------------------------------------------------------------------ reader
+def read_seqfile(path: str) -> Iterator[Tuple[bytes, bytes]]:
+    """Yield (key_bytes, value_bytes) decoded per the header's classes."""
+    with open(path, "rb") as f:
+        magic = f.read(3)
+        if magic != b"SEQ":
+            raise IOError(f"{path} is not a SequenceFile")
+        version = f.read(1)[0]
+        if version < 5:
+            raise NotImplementedError(f"SequenceFile version {version}")
+        key_cls = _read_hadoop_string(f)
+        val_cls = _read_hadoop_string(f)
+        compressed = f.read(1)[0] != 0
+        block = f.read(1)[0] != 0
+        if compressed or block:
+            raise NotImplementedError(
+                "compressed SequenceFiles are not supported (the reference "
+                "generator writes uncompressed)")
+        (meta_count,) = struct.unpack(">i", f.read(4))
+        for _ in range(meta_count):
+            _read_hadoop_string(f)
+            _read_hadoop_string(f)
+        sync = f.read(16)
+
+        def decode(cls, payload):
+            if cls == TEXT:
+                return _decode_text(payload)
+            if cls == BYTES_WRITABLE:
+                return _decode_bytes_writable(payload)
+            return payload
+
+        while True:
+            head = f.read(4)
+            if len(head) < 4:
+                return
+            (rec_len,) = struct.unpack(">i", head)
+            if rec_len == -1:   # sync marker
+                marker = f.read(16)
+                if marker != sync:
+                    raise IOError(f"corrupt sync marker in {path}")
+                continue
+            (key_len,) = struct.unpack(">i", f.read(4))
+            key = f.read(key_len)
+            value = f.read(rec_len - key_len)
+            if len(key) != key_len or len(value) != rec_len - key_len:
+                raise IOError(f"truncated SequenceFile record in {path}")
+            yield decode(key_cls, key), decode(val_cls, value)
+
+
+def write_seqfile(path: str, records: Sequence[Tuple[bytes, bytes]],
+                  key_cls: str = TEXT, val_cls: str = TEXT,
+                  sync_interval: int = 100) -> None:
+    """Write (key, value) byte pairs as an uncompressed SequenceFile
+    (``BGRImgToLocalSeqFile`` analog)."""
+    sync = np.random.default_rng(12345).bytes(16)
+
+    def encode(cls, payload: bytes) -> bytes:
+        if cls == TEXT:
+            return write_vint(len(payload)) + payload
+        if cls == BYTES_WRITABLE:
+            return struct.pack(">i", len(payload)) + payload
+        return payload
+
+    with open(path, "wb") as f:
+        f.write(b"SEQ" + bytes([_VERSION]))
+        f.write(_hadoop_string(key_cls))
+        f.write(_hadoop_string(val_cls))
+        f.write(bytes([0, 0]))          # no compression
+        f.write(struct.pack(">i", 0))   # no metadata
+        f.write(sync)
+        for i, (k, v) in enumerate(records):
+            if i and i % sync_interval == 0:
+                f.write(struct.pack(">i", -1))
+                f.write(sync)
+            ke = encode(key_cls, k)
+            ve = encode(val_cls, v)
+            f.write(struct.pack(">i", len(ke) + len(ve)))
+            f.write(struct.pack(">i", len(ke)))
+            f.write(ke)
+            f.write(ve)
+
+
+# ------------------------------------------------- reference key convention
+def parse_imagenet_key(key: bytes) -> Tuple[Optional[str], int]:
+    """``"<name>\\n<label>"`` or ``"<label>"`` → (name, label)
+    (``BGRImgToLocalSeqFile.scala:67-69``)."""
+    s = key.decode()
+    if "\n" in s:
+        name, label = s.rsplit("\n", 1)
+        return name, int(label)
+    return None, int(s)
+
+
+def seqfiles_to_byte_records(paths: Sequence[str]
+                             ) -> Iterator[Tuple[int, bytes]]:
+    """Stream (label, image_bytes) from sequence files
+    (``LocalSeqFileToBytes`` analog)."""
+    for p in paths:
+        for key, value in read_seqfile(p):
+            _, label = parse_imagenet_key(key)
+            yield label, value

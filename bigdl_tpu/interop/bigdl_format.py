@@ -453,7 +453,7 @@ class _Exporter:
             return {"inputFrameSize": _enc_attr_int(m.input_frame_size),
                     "outputFrameSize": _enc_attr_int(m.output_frame_size),
                     "kernelW": _enc_attr_int(m.kernel_w),
-                    "strideW": _enc_attr_int(m.stride)}
+                    "strideW": _enc_attr_int(m.stride_w)}
         return {}
 
     def encode(self, m: Module, params, state) -> bytes:

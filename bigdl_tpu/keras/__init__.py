@@ -17,6 +17,9 @@ from bigdl_tpu.keras.layers import (
     GlobalAveragePooling2D, GlobalMaxPooling2D, ZeroPadding2D,
     BatchNormalization, Embedding, SimpleRNN, LSTM, GRU, Bidirectional,
     TimeDistributed, InputLayer,
+    RepeatVector, Permute, Cropping2D, UpSampling2D, ZeroPadding1D,
+    MaxPooling1D, GlobalMaxPooling1D, GlobalAveragePooling1D, Highway,
+    MaxoutDense, SeparableConvolution2D, Merge,
 )
 from bigdl_tpu.keras.topology import Sequential, Model
 
@@ -26,5 +29,9 @@ __all__ = [
     "GlobalAveragePooling2D", "GlobalMaxPooling2D", "ZeroPadding2D",
     "BatchNormalization", "Embedding", "SimpleRNN", "LSTM", "GRU",
     "Bidirectional", "TimeDistributed", "InputLayer",
+    "RepeatVector", "Permute", "Cropping2D", "UpSampling2D",
+    "ZeroPadding1D", "MaxPooling1D", "GlobalMaxPooling1D",
+    "GlobalAveragePooling1D", "Highway", "MaxoutDense",
+    "SeparableConvolution2D", "Merge",
     "Sequential", "Model",
 ]

@@ -192,7 +192,7 @@ class Convolution1D(_WithActivation):
     def build(self, input_shape):
         return self._maybe_activate(nn.TemporalConvolution(
             int(input_shape[-1]), self.nb_filter, self.filter_length,
-            stride=self.subsample_length))
+            stride_w=self.subsample_length))
 
 
 class _Pooling2D(KerasLayer):
@@ -369,3 +369,173 @@ class TimeDistributed(KerasLayer):
     def build(self, input_shape):
         inner = self.layer.build(tuple(input_shape[1:]))
         return nn.TimeDistributed(inner)
+
+
+# ---------------------------------------------------------------- round-2b
+# breadth wrappers mapping onto existing core modules (reference
+# ``DL/nn/keras/`` has 71 named layers; the deferred-build pattern makes
+# each a few lines here)
+class RepeatVector(KerasLayer):
+    """(N, D) → (N, n, D) (reference ``RepeatVector.scala``)."""
+
+    def __init__(self, n: int, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n = n
+
+    def build(self, input_shape):
+        n = self.n
+        return nn.Lambda(lambda x: jnp.repeat(x[:, None], n, axis=1))
+
+
+class Permute(KerasLayer):
+    """Permute non-batch dims, 1-based like Keras (reference
+    ``Permute.scala``)."""
+
+    def __init__(self, dims, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dims = tuple(dims)
+
+    def build(self, input_shape):
+        perm = (0,) + tuple(d for d in self.dims)
+        return nn.Lambda(lambda x: jnp.transpose(x, perm))
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.cropping = cropping
+        self.dim_ordering = dim_ordering
+
+    def build(self, input_shape):
+        (t, b), (l, r) = self.cropping
+        if self.dim_ordering == "th":
+            return nn.Cropping2D((t, b), (l, r))
+        return nn.Lambda(lambda x: x[:, t:x.shape[1] - b,
+                                     l:x.shape[2] - r, :])
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), dim_ordering="th", input_shape=None,
+                 name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = tuple(size)
+        self.dim_ordering = dim_ordering
+
+    def build(self, input_shape):
+        if self.dim_ordering != "th":
+            sh, sw = self.size
+            return nn.Lambda(lambda x: jnp.repeat(
+                jnp.repeat(x, sh, axis=1), sw, axis=2))
+        return nn.UpSampling2D(self.size)
+
+
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding: int = 1, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.padding = padding
+
+    def build(self, input_shape):
+        p = self.padding
+        return nn.Lambda(lambda x: jnp.pad(x, ((0, 0), (p, p), (0, 0))))
+
+
+class MaxPooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride=None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def build(self, input_shape):
+        return nn.TemporalMaxPooling(self.pool_length, self.stride)
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def build(self, input_shape):
+        return nn.Lambda(lambda x: jnp.max(x, axis=1))
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def build(self, input_shape):
+        return nn.Lambda(lambda x: jnp.mean(x, axis=1))
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.activation = activation
+
+    def build(self, input_shape):
+        act_mod = activation_module(self.activation)
+        act = None
+        if act_mod is not None:
+            # nn.Highway takes the g function itself
+            act = lambda x: act_mod.apply({}, {}, x)[0]
+        return nn.Highway(int(input_shape[-1]), activation=act)
+
+
+class MaxoutDense(KerasLayer):
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+
+    def build(self, input_shape):
+        return nn.Maxout(int(input_shape[-1]), self.output_dim,
+                         self.nb_feature)
+
+
+class SeparableConvolution2D(_WithActivation):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, depth_multiplier: int = 1,
+                 dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.depth_multiplier = depth_multiplier
+        self.dim_ordering = dim_ordering
+
+    def build(self, input_shape):
+        if self.dim_ordering != "th":
+            raise NotImplementedError(
+                "SeparableConvolution2D supports dim_ordering='th' only "
+                "(the core module is NCHW); transpose inputs or use "
+                "nn.SpatialSeparableConvolution directly")
+        ch = int(input_shape[0])
+        return self._maybe_activate(nn.SpatialSeparableConvolution(
+            ch, self.nb_filter, self.depth_multiplier,
+            self.nb_col, self.nb_row))
+
+
+class Merge(KerasLayer):
+    """Merge a list of inputs (reference ``Merge.scala``).  Use via
+    ``.build(...)`` on table-valued inputs or in a core ``nn.Graph`` —
+    NOT inside a Keras ``Sequential`` (its layers are single-tensor;
+    shape inference raises to prevent silent miswiring)."""
+
+    def __init__(self, mode: str = "sum", concat_axis: int = -1,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def output_shape(self, input_shape):
+        raise TypeError(
+            "Merge cannot appear in a Keras Sequential (single-tensor "
+            "pipeline); apply its .build(...) module to a table of "
+            "tensors or use nn.Graph")
+
+    def build(self, input_shape):
+        if self.mode == "sum":
+            return nn.CAddTable()
+        if self.mode == "mul":
+            return nn.CMulTable()
+        if self.mode == "max":
+            return nn.CMaxTable()
+        if self.mode == "concat":
+            return nn.JoinTable(self.concat_axis)
+        if self.mode == "ave":
+            return nn.CAveTable()
+        raise ValueError(f"unknown merge mode {self.mode!r}")

@@ -282,3 +282,86 @@ class TestReviewFixes:
             iter([Sample(np.zeros((2, 2, 3), np.float32), 0)]))))
         np.testing.assert_allclose(s.feature, 0.0)
         assert imgops.LIGHTING_EIGVAL.shape == (3,)
+
+
+class TestSequenceFile:
+    def test_roundtrip_and_sync_markers(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile as sq
+        p = str(tmp_path / "part-0.seq")
+        recs = [(f"img{i}\n{i % 7}".encode(), bytes([i % 251]) * (50 + i))
+                for i in range(300)]
+        sq.write_seqfile(p, recs, sync_interval=64)
+        back = list(sq.read_seqfile(p))
+        assert len(back) == 300
+        assert back[0][0] == b"img0\n0"
+        assert back[123][1] == recs[123][1]
+
+    def test_imagenet_key_convention(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile as sq
+        assert sq.parse_imagenet_key(b"n0123/img.jpg\n42") == \
+            ("n0123/img.jpg", 42)
+        assert sq.parse_imagenet_key(b"7") == (None, 7)
+        p = str(tmp_path / "p.seq")
+        sq.write_seqfile(p, [(b"a\n3", b"xyz"), (b"5", b"pq")])
+        out = list(sq.seqfiles_to_byte_records([p]))
+        assert out == [(3, b"xyz"), (5, b"pq")]
+
+    def test_vint_edge_cases(self):
+        from bigdl_tpu.dataset.seqfile import read_vint, write_vint
+        for v in (0, 1, -1, 127, -112, 128, -113, 1 << 20, -(1 << 20),
+                  (1 << 31) - 1):
+            b = write_vint(v)
+            got, pos = read_vint(b, 0)
+            assert got == v and pos == len(b)
+
+    def test_rejects_compressed(self, tmp_path):
+        from bigdl_tpu.dataset import seqfile as sq
+        import struct
+        p = str(tmp_path / "c.seq")
+        with open(p, "wb") as f:
+            f.write(b"SEQ\x06")
+            f.write(sq._hadoop_string(sq.TEXT))
+            f.write(sq._hadoop_string(sq.TEXT))
+            f.write(bytes([1, 0]))  # compressed=True
+            f.write(struct.pack(">i", 0))
+            f.write(b"\x00" * 16)
+        with pytest.raises(NotImplementedError, match="compressed"):
+            list(sq.read_seqfile(p))
+
+
+class TestBuiltinLoaders:
+    def test_movielens_format_and_parse(self, tmp_path):
+        from bigdl_tpu.dataset import movielens
+        syn = movielens.synthetic_ratings(n_ratings=50)
+        assert syn.shape == (50, 3)
+        assert syn[:, 2].min() >= 1 and syn[:, 2].max() <= 5
+        p = tmp_path / "ratings.dat"
+        p.write_text("\n".join(f"{u}::{i}::{r}::0" for u, i, r in syn))
+        back = movielens.load(str(tmp_path))
+        np.testing.assert_array_equal(back, syn)
+        samples = movielens.to_implicit_samples(syn)
+        assert samples[0].feature.shape == (2,)
+
+    def test_news20_tree_and_synthetic(self, tmp_path):
+        from bigdl_tpu.dataset import news20
+        for cat, docs in (("alt.atheism", ["hello world"]),
+                          ("sci.space", ["rockets fly", "orbit high"])):
+            d = tmp_path / cat
+            d.mkdir()
+            for i, t in enumerate(docs):
+                (d / f"{i}").write_text(t)
+        texts, labels, cats = news20.load(str(tmp_path))
+        assert cats == ["alt.atheism", "sci.space"]
+        assert list(labels) == [0, 1, 1]
+        texts2, labels2, cats2 = news20.synthetic_news(50, 3)
+        assert len(texts2) == 50 and set(labels2) <= {0, 1, 2}
+
+
+def test_seqfile_truncation_detected(tmp_path):
+    from bigdl_tpu.dataset import seqfile as sq
+    p = str(tmp_path / "t.seq")
+    sq.write_seqfile(p, [(b"k", b"v" * 100)])
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:-20])  # cut mid-value
+    with pytest.raises(IOError, match="truncated"):
+        list(sq.read_seqfile(p))

@@ -391,3 +391,85 @@ class TestReviewFixesE:
         blobs = [np.zeros((2, 3, 3, 3), np.float32)]
         m, _ = _conv_module("c", cp, blobs)
         assert m.dilation == (2, 2)
+
+
+class TestTFExport:
+    def test_lenet_roundtrip_through_graphdef(self, tmp_path):
+        from bigdl_tpu.interop import load_tf_graph, save_tf_graph
+        from bigdl_tpu.models.lenet import lenet5
+        m = lenet5(class_num=10)
+        m.initialize(rng=4)
+        m.training = False
+        x = np.random.RandomState(0).rand(2, 1, 28, 28).astype(np.float32)
+        ref = np.asarray(m.forward(x))
+        p = str(tmp_path / "lenet.pb")
+        inp, out = save_tf_graph(m, p, input_shape=(2, 1, 28, 28))
+        m2 = load_tf_graph(p, inputs=[inp], outputs=[out])
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), ref,
+                                   atol=1e-5)
+
+    def test_bn_folded_export(self, tmp_path):
+        from bigdl_tpu.interop import load_tf_graph, save_tf_graph
+        m = nn.Sequential(nn.Linear(4, 6), nn.BatchNormalization(6),
+                          nn.ReLU())
+        m.initialize(rng=1)
+        x = np.random.RandomState(1).rand(8, 4).astype(np.float32)
+        m.training = True
+        for _ in range(3):
+            m.forward(x, rng=jax.random.PRNGKey(0))
+        m.training = False
+        ref = np.asarray(m.forward(x))
+        p = str(tmp_path / "bn.pb")
+        inp, out = save_tf_graph(m, p, input_shape=(8, 4))
+        m2 = load_tf_graph(p, inputs=[inp], outputs=[out])
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), ref,
+                                   atol=1e-5)
+
+    def test_unsupported_module_reports(self, tmp_path):
+        from bigdl_tpu.interop import save_tf_graph
+        m = nn.Sequential(nn.PReLU())
+        m.initialize()
+        with pytest.raises(NotImplementedError, match="PReLU"):
+            save_tf_graph(m, str(tmp_path / "x.pb"), input_shape=(1, 4))
+
+
+def test_temporal_convolution_roundtrip(tmp_path):
+    # regression: exporter read m.stride (nonexistent) instead of stride_w
+    m = nn.Sequential(nn.TemporalConvolution(5, 7, 3, 2))
+    m.initialize()
+    x = np.random.RandomState(0).rand(2, 9, 5).astype(np.float32)
+    ref = np.asarray(m.forward(x))
+    p = str(tmp_path / "tc.bigdl")
+    save_bigdl_module(m, p)
+    m2 = load_bigdl_module(p)
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), ref, atol=1e-6)
+
+
+def test_dilated_conv_tf_export_roundtrip(tmp_path):
+    # regression: exporter dropped the dilations attr
+    from bigdl_tpu.interop import load_tf_graph, save_tf_graph
+    m = nn.Sequential(nn.SpatialConvolution(2, 3, 3, 3, dilation_w=2,
+                                            dilation_h=2))
+    m.initialize()
+    x = np.random.RandomState(0).rand(1, 2, 9, 9).astype(np.float32)
+    ref = np.asarray(m.forward(x))
+    p = str(tmp_path / "dil.pb")
+    inp, out = save_tf_graph(m, p, input_shape=(1, 2, 9, 9))
+    m2 = load_tf_graph(p, inputs=[inp], outputs=[out])
+    got = np.asarray(m2.forward(x))
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_residual_block_tf_export_roundtrip(tmp_path):
+    from bigdl_tpu.interop import load_tf_graph, save_tf_graph
+    from bigdl_tpu.models.resnet import basic_block
+    m = nn.Sequential(basic_block(4, 8, 2))
+    m.initialize()
+    m.training = False
+    x = np.random.RandomState(1).rand(2, 4, 8, 8).astype(np.float32)
+    ref = np.asarray(m.forward(x))
+    p = str(tmp_path / "res.pb")
+    inp, out = save_tf_graph(m, p, input_shape=(2, 4, 8, 8))
+    m2 = load_tf_graph(p, inputs=[inp], outputs=[out])
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), ref, atol=1e-4)

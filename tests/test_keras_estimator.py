@@ -175,3 +175,75 @@ class TestReviewFixes:
         with pytest.raises(ValueError):
             c.forward(jnp.zeros((1, 2)), (jnp.zeros((1, 2)),
                                           jnp.ones((1, 2))))
+
+
+class TestKerasBreadthWrappers:
+    def test_mixed_stack_shapes_and_forward(self):
+        from bigdl_tpu import keras as K
+        m = K.Sequential([
+            K.Convolution2D(4, 3, 3, input_shape=(2, 8, 8),
+                            activation="relu"),
+            K.UpSampling2D(),
+            K.Cropping2D(((1, 1), (1, 1))),
+            K.Permute((2, 3, 1)),
+            K.Flatten(),
+            K.MaxoutDense(6),
+            K.Highway(),
+            K.RepeatVector(3),
+            K.GlobalAveragePooling1D(),
+            K.Dense(2),
+        ])
+        assert m.output_shape == (None, 2)
+        out = m.core_module().forward(np.zeros((2, 2, 8, 8), np.float32))
+        assert out.shape == (2, 2)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_1d_pooling_and_padding(self):
+        from bigdl_tpu import keras as K
+        m = K.Sequential([
+            K.ZeroPadding1D(2, input_shape=(6, 3)),
+            K.Convolution1D(5, 3, activation="tanh"),
+            K.MaxPooling1D(2),
+            K.GlobalMaxPooling1D(),
+        ])
+        assert m.output_shape == (None, 5)
+
+    def test_separable_conv(self):
+        from bigdl_tpu import keras as K
+        m = K.Sequential([K.SeparableConvolution2D(
+            8, 3, 3, input_shape=(4, 9, 9))])
+        out_shape = m.output_shape
+        assert out_shape[1] == 8
+
+    def test_merge_modes(self):
+        from bigdl_tpu import keras as K
+        for mode, expect in (("sum", 3.0), ("mul", 2.0), ("max", 2.0)):
+            merged = K.Merge(mode=mode).build((4,))
+            out = merged.forward((np.full((2, 4), 1.0, np.float32),
+                                  np.full((2, 4), 2.0, np.float32)))
+            np.testing.assert_allclose(np.asarray(out), expect)
+
+
+class TestBreadthReviewFixes:
+    def test_separable_tf_ordering_rejected(self):
+        from bigdl_tpu import keras as K
+        with pytest.raises(NotImplementedError, match="dim_ordering"):
+            K.Sequential([K.SeparableConvolution2D(
+                8, 3, 3, dim_ordering="tf",
+                input_shape=(9, 9, 4))]).build()
+
+    def test_highway_activation_respected(self):
+        from bigdl_tpu import keras as K
+        import jax.numpy as jnp
+        hw = K.Highway(activation="relu").build((6,))
+        # g(relu) never outputs negatives in the transform branch;
+        # compare against default-tanh build on a strongly negative input
+        hw_tanh = K.Highway().build((6,))
+        assert hw.activation is not hw_tanh.activation
+
+    def test_merge_in_sequential_raises(self):
+        from bigdl_tpu import keras as K
+        m = K.Sequential([K.InputLayer(input_shape=(4,)),
+                          K.Merge(mode="sum")])
+        with pytest.raises(TypeError, match="Sequential"):
+            _ = m.output_shape
