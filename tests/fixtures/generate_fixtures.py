@@ -42,14 +42,18 @@ def _t(x):
                         requires_grad=False)
 
 
-def _record(name, params, x, torch_fwd, extra_inputs=None):
+def _record(name, params, x, torch_fwd, state=None):
     """Run torch_fwd(params as torch tensors, x) -> out; record fixture.
 
-    grad targets: d(sum(out))/d(x) and /d(each param).
+    grad targets: d(sum(out))/d(x) and /d(each param).  ``state`` entries
+    (e.g. BN running stats) reach torch_fwd via ``p`` too but are stored
+    as ``s_*`` and replayed through the module STATE dict, without grads.
     All torch math in float64 so the fixture is a high-precision oracle;
     the replay asserts float32-level tolerance.
     """
+    state = state or {}
     tp = {k: _t(v).requires_grad_(True) for k, v in params.items()}
+    tp.update({k: _t(v) for k, v in state.items()})
     tx = _t(x).requires_grad_(True)
     out = torch_fwd(tp, tx)
     loss = out.sum()
@@ -62,6 +66,8 @@ def _record(name, params, x, torch_fwd, extra_inputs=None):
     for k, v in params.items():
         blob[f"p_{k}"] = np.asarray(v, np.float64)
         blob[f"dp_{k}"] = tp[k].grad.numpy()
+    for k, v in state.items():
+        blob[f"s_{k}"] = np.asarray(v, np.float64)
     os.makedirs(DATA_DIR, exist_ok=True)
     np.savez(os.path.join(DATA_DIR, f"{name}.npz"), **blob)
     print(f"  {name}: out{tuple(out.shape)}")
@@ -233,6 +239,219 @@ def main(only=None):
         # salted per process), so regeneration is byte-reproducible
         fn(np.random.default_rng(zlib.crc32(name.encode()) % (2**31)))
     print(f"{len(CASES)} fixtures written to {DATA_DIR}")
+
+
+
+# ====================================================== round-2b batch
+# core 2-D layers, normalization, activations, criterions — the grind
+# toward VERDICT item 4's "each with a fixture test"
+@case("spatial_convolution_pad_stride")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 9, 9))
+    params = {"weight": rng.normal(0, 0.2, (5, 3, 3, 3)),
+              "bias": rng.normal(0, 0.1, (5,))}
+
+    def fwd(p, x):
+        return F.conv2d(x, p["weight"], p["bias"], stride=2, padding=1)
+    _record("spatial_convolution_pad_stride", params, x, fwd)
+
+
+@case("spatial_convolution_grouped")
+def _(rng):
+    x = rng.normal(0, 1, (2, 4, 8, 8))
+    params = {"weight": rng.normal(0, 0.2, (6, 2, 3, 3)),
+              "bias": rng.normal(0, 0.1, (6,))}
+
+    def fwd(p, x):
+        return F.conv2d(x, p["weight"], p["bias"], groups=2)
+    _record("spatial_convolution_grouped", params, x, fwd)
+
+
+@case("spatial_full_convolution")
+def _(rng):
+    x = rng.normal(0, 1, (2, 4, 5, 5))
+    params = {"weight": rng.normal(0, 0.2, (4, 3, 3, 3)),  # (in, out, kh, kw)
+              "bias": rng.normal(0, 0.1, (3,))}
+
+    def fwd(p, x):
+        return F.conv_transpose2d(x, p["weight"], p["bias"], stride=2,
+                                  padding=1, output_padding=1)
+    _record("spatial_full_convolution", params, x, fwd)
+
+
+@case("spatial_max_pooling_ceil")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 7, 7))
+
+    def fwd(p, x):
+        return F.max_pool2d(x, 3, stride=2, ceil_mode=True)
+    _record("spatial_max_pooling_ceil", {}, x, fwd)
+
+
+@case("spatial_avg_pooling_pad")
+def _(rng):
+    x = rng.normal(0, 1, (2, 3, 8, 8))
+
+    def fwd(p, x):
+        return F.avg_pool2d(x, 3, stride=2, padding=1,
+                            count_include_pad=True)
+    _record("spatial_avg_pooling_pad", {}, x, fwd)
+
+
+@case("linear")
+def _(rng):
+    x = rng.normal(0, 1, (4, 7))
+    params = {"weight": rng.normal(0, 0.3, (5, 7)),
+              "bias": rng.normal(0, 0.1, (5,))}
+
+    def fwd(p, x):
+        return F.linear(x, p["weight"], p["bias"])
+    _record("linear", params, x, fwd)
+
+
+@case("spatial_batch_norm_eval")
+def _(rng):
+    x = rng.normal(0, 1, (3, 4, 5, 5))
+    params = {"weight": rng.uniform(0.5, 1.5, (4,)),
+              "bias": rng.normal(0, 0.2, (4,))}
+    state = {"running_mean": rng.normal(0, 0.3, (4,)),
+             "running_var": rng.uniform(0.5, 2.0, (4,))}
+
+    def fwd(p, x):
+        return F.batch_norm(x, p["running_mean"], p["running_var"],
+                            p["weight"], p["bias"], training=False,
+                            eps=1e-5)
+    _record("spatial_batch_norm_eval", params, x, fwd, state=state)
+
+
+@case("prelu")
+def _(rng):
+    x = rng.normal(0, 1, (3, 4, 5))
+    params = {"weight": rng.uniform(0.1, 0.4, (1,))}  # our PReLU key
+
+    def fwd(p, x):
+        return F.prelu(x, p["weight"])
+    _record("prelu", params, x, fwd)
+
+
+@case("elu")
+def _(rng):
+    x = rng.normal(0, 2, (3, 6))
+
+    def fwd(p, x):
+        return F.elu(x, alpha=1.0)
+    _record("elu", {}, x, fwd)
+
+
+@case("softplus")
+def _(rng):
+    x = rng.normal(0, 2, (3, 6))
+
+    def fwd(p, x):
+        return F.softplus(x)
+    _record("softplus", {}, x, fwd)
+
+
+@case("hard_tanh")
+def _(rng):
+    x = rng.normal(0, 2, (3, 6))
+
+    def fwd(p, x):
+        return F.hardtanh(x, -1.0, 1.0)
+    _record("hard_tanh", {}, x, fwd)
+
+
+@case("spatial_cross_map_lrn")
+def _(rng):
+    x = rng.uniform(0.1, 1.0, (2, 8, 5, 5))
+
+    def fwd(p, x):
+        return F.local_response_norm(x, size=5, alpha=1.0, beta=0.75, k=1.0)
+    _record("spatial_cross_map_lrn", {}, x, fwd)
+
+
+# ------------------------------------------------------------ criterions
+def _record_criterion(name, x, target, torch_loss):
+    tx = _t(x).requires_grad_(True)
+    tt = torch.tensor(np.asarray(target))
+    loss = torch_loss(tx, tt)
+    loss.backward()
+    os.makedirs(DATA_DIR, exist_ok=True)
+    np.savez(os.path.join(DATA_DIR, f"crit_{name}.npz"),
+             x=np.asarray(x, np.float64), target=np.asarray(target),
+             loss=loss.detach().numpy(), dx=tx.grad.numpy())
+    print(f"  crit_{name}: loss={float(loss):.6f}")
+
+
+@case("crit_mse")
+def _(rng):
+    _record_criterion("mse", rng.normal(0, 1, (4, 5)),
+                      rng.normal(0, 1, (4, 5)),
+                      lambda x, t: F.mse_loss(x, t))
+
+
+@case("crit_abs")
+def _(rng):
+    _record_criterion("abs", rng.normal(0, 1, (4, 5)),
+                      rng.normal(0, 1, (4, 5)),
+                      lambda x, t: F.l1_loss(x, t))
+
+
+@case("crit_bce")
+def _(rng):
+    _record_criterion("bce", rng.uniform(0.05, 0.95, (4, 5)),
+                      rng.integers(0, 2, (4, 5)).astype(np.float64),
+                      lambda x, t: F.binary_cross_entropy(x, t))
+
+
+@case("crit_smooth_l1")
+def _(rng):
+    _record_criterion("smooth_l1", rng.normal(0, 2, (4, 5)),
+                      rng.normal(0, 2, (4, 5)),
+                      lambda x, t: F.smooth_l1_loss(x, t))
+
+
+@case("crit_class_nll_weighted")
+def _(rng):
+    logits = rng.normal(0, 1, (6, 4))
+    logp = np.log(np.exp(logits) / np.exp(logits).sum(1, keepdims=True))
+    target = rng.integers(0, 4, (6,)).astype(np.int64)
+    w = torch.tensor([0.5, 1.0, 2.0, 1.5], dtype=torch.float64)
+    _record_criterion("class_nll_weighted", logp, target,
+                      lambda x, t: F.nll_loss(x, t, weight=w))
+
+
+@case("crit_dist_kl")
+def _(rng):
+    logp = np.log(rng.dirichlet(np.ones(5), size=4))
+    q = rng.dirichlet(np.ones(5), size=4)
+    _record_criterion("dist_kl", logp, q,
+                      lambda x, t: F.kl_div(x, t, reduction="batchmean"))
+
+
+@case("crit_soft_margin")
+def _(rng):
+    x = rng.normal(0, 1, (4, 5))
+    t = rng.choice([-1.0, 1.0], (4, 5))
+    _record_criterion("soft_margin", x, t,
+                      lambda x, t: F.soft_margin_loss(x, t))
+
+
+@case("crit_hinge_embedding")
+def _(rng):
+    x = rng.uniform(0, 2, (8,))
+    t = rng.choice([-1.0, 1.0], (8,))
+    _record_criterion("hinge_embedding", x, t,
+                      lambda x, t: F.hinge_embedding_loss(x, t,
+                                                          margin=1.0))
+
+
+@case("crit_multilabel_soft_margin")
+def _(rng):
+    x = rng.normal(0, 1, (4, 6))
+    t = rng.integers(0, 2, (4, 6)).astype(np.float64)
+    _record_criterion("multilabel_soft_margin", x, t,
+                      lambda x, t: F.multilabel_soft_margin_loss(x, t))
 
 
 if __name__ == "__main__":

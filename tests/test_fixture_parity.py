@@ -17,8 +17,9 @@ from bigdl_tpu import nn
 
 DATA_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "data")
 
-# fixture name -> module factory.  The module's apply(params, {}, x) must
-# reproduce the recorded torch computation.
+# fixture name -> module factory.  The module's apply(params, state, x)
+# must reproduce the recorded torch computation; ``s_*`` fixture entries
+# feed the state dict (no grads), everything else is a trained param.
 MODULES = {
     "volumetric_convolution": lambda: nn.VolumetricConvolution(
         3, 4, 2, 3, 3, 1, 2, 2, 0, 1, 1),
@@ -40,6 +41,25 @@ MODULES = {
         8, 9, align_corners=True),
     "temporal_max_pooling": lambda: nn.TemporalMaxPooling(2, 2),
     "temporal_convolution": lambda: nn.TemporalConvolution(5, 6, 3, 2),
+    # round-2b batch
+    "spatial_convolution_pad_stride": lambda: nn.SpatialConvolution(
+        3, 5, 3, 3, 2, 2, 1, 1),
+    "spatial_convolution_grouped": lambda: nn.SpatialConvolution(
+        4, 6, 3, 3, n_group=2),
+    "spatial_full_convolution": lambda: nn.SpatialFullConvolution(
+        4, 3, 3, 3, 2, 2, 1, 1, 1, 1),
+    "spatial_max_pooling_ceil": lambda: nn.SpatialMaxPooling(
+        3, 3, 2, 2, ceil_mode=True),
+    "spatial_avg_pooling_pad": lambda: nn.SpatialAveragePooling(
+        3, 3, 2, 2, 1, 1, count_include_pad=True),
+    "linear": lambda: nn.Linear(7, 5),
+    "prelu": lambda: nn.PReLU(),
+    "elu": lambda: nn.ELU(),
+    "softplus": lambda: nn.SoftPlus(),
+    "hard_tanh": lambda: nn.HardTanh(),
+    "spatial_cross_map_lrn": lambda: nn.SpatialCrossMapLRN(
+        5, 1.0, 0.75, 1.0),
+    "spatial_batch_norm_eval": lambda: nn.SpatialBatchNormalization(4),
 }
 
 TOL = dict(rtol=2e-4, atol=2e-5)
@@ -52,23 +72,26 @@ def _load(name):
     z = np.load(path)
     params = {k[2:]: z[k] for k in z.files if k.startswith("p_")}
     dparams = {k[3:]: z[k] for k in z.files if k.startswith("dp_")}
-    return z["x"], params, z["out"], z["dx"], dparams
+    state = {k[2:]: z[k] for k in z.files if k.startswith("s_")}
+    return z["x"], params, state, z["out"], z["dx"], dparams
 
 
 @pytest.mark.parametrize("name", sorted(MODULES))
 def test_fixture_parity(name):
-    x, params, want_out, want_dx, want_dp = _load(name)
+    x, params, state, want_out, want_dx, want_dp = _load(name)
     mod = MODULES[name]()
     jparams = jax.tree_util.tree_map(
         lambda a: jnp.asarray(a, jnp.float32), params)
+    jstate = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a, jnp.float32), state)
     jx = jnp.asarray(x, jnp.float32)
 
-    out, _ = mod.apply(jparams, {}, jx, training=False)
+    out, _ = mod.apply(jparams, jstate, jx, training=False)
     np.testing.assert_allclose(np.asarray(out), want_out, **TOL,
                                err_msg=f"{name}: forward mismatch")
 
     def loss(p, xx):
-        y, _ = mod.apply(p, {}, xx, training=False)
+        y, _ = mod.apply(p, jstate, xx, training=False)
         return jnp.sum(y)
 
     dp, dx = jax.grad(loss, argnums=(0, 1))(jparams, jx)
@@ -77,3 +100,35 @@ def test_fixture_parity(name):
     for k, want in want_dp.items():
         np.testing.assert_allclose(np.asarray(dp[k]), want, **TOL,
                                    err_msg=f"{name}: grad_{k} mismatch")
+
+
+# -------------------------------------------------------------- criterions
+CRITERIONS = {
+    "mse": lambda: nn.MSECriterion(),
+    "abs": lambda: nn.AbsCriterion(),
+    "bce": lambda: nn.BCECriterion(),
+    "smooth_l1": lambda: nn.SmoothL1Criterion(),
+    "class_nll_weighted": lambda: nn.ClassNLLCriterion(
+        weights=jnp.asarray([0.5, 1.0, 2.0, 1.5])),
+    "dist_kl": lambda: nn.DistKLDivCriterion(),
+    "soft_margin": lambda: nn.SoftMarginCriterion(),
+    "hinge_embedding": lambda: nn.HingeEmbeddingCriterion(margin=1.0),
+    "multilabel_soft_margin": lambda: nn.MultiLabelSoftMarginCriterion(),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CRITERIONS))
+def test_criterion_fixture_parity(name):
+    path = os.path.join(DATA_DIR, f"crit_{name}.npz")
+    if not os.path.exists(path):
+        pytest.skip("fixture not generated")
+    z = np.load(path)
+    crit = CRITERIONS[name]()
+    x = jnp.asarray(z["x"], jnp.float32)
+    t = jnp.asarray(z["target"])
+    loss = crit.apply(x, t)
+    np.testing.assert_allclose(float(loss), float(z["loss"]), rtol=2e-4,
+                               err_msg=f"{name}: loss mismatch")
+    dx = jax.grad(lambda xx: crit.apply(xx, t))(x)
+    np.testing.assert_allclose(np.asarray(dx), z["dx"], **TOL,
+                               err_msg=f"{name}: grad mismatch")
