@@ -15,3 +15,4 @@ from bigdl_tpu.interop.keras_format import (
     load_keras_json, set_keras_weights, load_keras_hdf5_weights,
 )
 from bigdl_tpu.interop.tf_export import save_tf_graph
+from bigdl_tpu.interop.session import TFSession

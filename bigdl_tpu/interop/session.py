@@ -1,0 +1,64 @@
+"""Train a loaded TF graph — the ``BigDLSessionImpl.train`` analog.
+
+Reference: ``DL/utils/tf/Session.scala:43,105`` — ``train:111`` takes the
+loss-node endpoints of an imported GraphDef, wires the queue-runner inputs
+to an RDD, and hands the whole thing to DistriOptimizer.
+
+TPU redesign: the imported :class:`TFGraphModule` is already a normal
+functional module whose VariableV2 nodes are trainable params, so
+"session training" is just adapter glue: pick the loss output (or an
+output + criterion), feed batches from a ``DataSet``, and drive
+``LocalOptimizer``/``DistriOptimizer``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.interop.tf_format import TFGraphModule, load_tf_graph
+
+
+class TFSession:
+    """(reference ``BigDLSessionImpl``) — train/fine-tune an imported
+    GraphDef with the framework's optimizers."""
+
+    def __init__(self, graph_or_path, inputs: Optional[Sequence[str]] = None,
+                 outputs: Optional[Sequence[str]] = None):
+        if isinstance(graph_or_path, TFGraphModule):
+            self.graph = graph_or_path
+        else:
+            if inputs is None or outputs is None:
+                raise ValueError("loading from a path needs inputs= and "
+                                 "outputs= node names")
+            self.graph = load_tf_graph(graph_or_path, inputs, outputs)
+
+    def train(self, dataset: AbstractDataSet,
+              criterion: nn.Criterion,
+              optim_method: Optional[optim.OptimMethod] = None,
+              end_when: Optional[optim.Trigger] = None,
+              distributed: bool = False, mesh=None):
+        """Train the imported graph's variables on ``dataset``
+        (reference ``Session.train:111``).  The optimizer pairs the
+        graph's output with ``criterion`` against each batch's target and
+        writes the trained variables back onto the module.  Returns the
+        optimizer (its ``state`` carries loss/epoch)."""
+        if distributed:
+            opt = optim.DistriOptimizer(self.graph, dataset, criterion,
+                                        mesh=mesh)
+        else:
+            opt = optim.LocalOptimizer(self.graph, dataset, criterion)
+        opt.set_optim_method(optim_method or optim.SGD(
+            learning_rate=0.01, momentum=0.9, dampening=0.0))
+        opt.set_end_when(end_when or optim.max_epoch(1))
+        opt.optimize()
+        return opt
+
+    def run(self, feeds) -> np.ndarray:
+        """Forward the graph on host arrays (``session.run`` analog)."""
+        out = self.graph.forward(feeds)
+        import jax
+        return jax.tree_util.tree_map(np.asarray, out)

@@ -473,3 +473,41 @@ def test_residual_block_tf_export_roundtrip(tmp_path):
     inp, out = save_tf_graph(m, p, input_shape=(2, 4, 8, 8))
     m2 = load_tf_graph(p, inputs=[inp], outputs=[out])
     np.testing.assert_allclose(np.asarray(m2.forward(x)), ref, atol=1e-4)
+
+
+class TestTFSession:
+    def test_train_imported_graph(self):
+        path = os.path.join(REF_TF, "lenet_batch_2.pbtxt")
+        if not os.path.exists(path):
+            pytest.skip("reference resources unavailable")
+        from bigdl_tpu import optim
+        from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.interop import TFSession
+
+        sess = TFSession(path, inputs=["fifo_queue_Dequeue"],
+                         outputs=["Predictions/Softmax"])
+        rng = np.random.RandomState(1)
+        imgs = rng.rand(64, 28, 28, 1).astype(np.float32)
+        labels = rng.randint(0, 10, 64)
+        for i, l in enumerate(labels):
+            imgs[i, l * 2:(l + 1) * 2, :, 0] += 2.0
+        samples = [Sample(imgs[i], np.int32(labels[i])) for i in range(64)]
+
+        class LogNLL(nn.Criterion):
+            def apply(self, input, target):
+                return nn.ClassNLLCriterion().apply(
+                    jnp.log(input + 1e-8), target)
+
+        # graph bakes batch 32 into its flatten const
+        opt = sess.train(DataSet.array(samples) >> SampleToMiniBatch(32),
+                         LogNLL(),
+                         optim_method=optim.SGD(learning_rate=0.01,
+                                                momentum=0.9,
+                                                dampening=0.0),
+                         end_when=optim.max_epoch(5))
+        assert opt.state["loss"] < 1.0, opt.state["loss"]
+        # trained variables persisted onto the session's graph
+        probs = sess.run(imgs[:32])
+        acc = (np.argmax(probs, -1) == labels[:32]).mean()
+        assert acc > 0.7, acc
