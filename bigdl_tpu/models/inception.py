@@ -64,3 +64,87 @@ def inception_v1(class_num: int = 1000) -> nn.Sequential:
     m.add(nn.Linear(1024, class_num, weight_init=Xavier()))
     m.add(nn.LogSoftMax())
     return m
+
+
+# ---------------------------------------------------------- Inception v2
+def _conv_bn(in_c, out_c, k, stride=1, pad=0, name=""):
+    """conv + BN(1e-3) + ReLU — the v2 building block (reference
+    ``Inception_v2.scala`` pairs every conv with SpatialBatchNormalization)."""
+    return (nn.Sequential(name=name)
+            .add(nn.SpatialConvolution(in_c, out_c, k, k, stride, stride,
+                                       pad, pad, weight_init=Xavier(),
+                                       name=f"{name}_conv"))
+            .add(nn.SpatialBatchNormalization(out_c, eps=1e-3,
+                                              name=f"{name}/bn"))
+            .add(nn.ReLU()))
+
+
+def inception_layer_v2(in_c, c1, c3, cd3, pool, name):
+    """BN-Inception module (reference ``Inception_Layer_v2``).
+
+    ``c1``: 1x1 tower width (0 = stride-2 reduction module, tower absent);
+    ``c3``: (reduce, out) 3x3 tower; ``cd3``: (reduce, out) double-3x3
+    tower; ``pool``: ("avg"|"max", proj) — proj 0 = bare pooling.
+    The stride-2 form strides the 3x3 / second double-3x3 / pool.
+    """
+    stride = 1 if c1 > 0 else 2
+    m = nn.Concat(1, name=name)
+    if c1 > 0:
+        m.add(_conv_bn(in_c, c1, 1, name=f"{name}1x1"))
+    m.add(nn.Sequential()
+          .add(_conv_bn(in_c, c3[0], 1, name=f"{name}3x3_reduce"))
+          .add(_conv_bn(c3[0], c3[1], 3, stride, 1, name=f"{name}3x3")))
+    m.add(nn.Sequential()
+          .add(_conv_bn(in_c, cd3[0], 1, name=f"{name}double3x3_reduce"))
+          .add(_conv_bn(cd3[0], cd3[1], 3, 1, 1, name=f"{name}double3x3a"))
+          .add(_conv_bn(cd3[1], cd3[1], 3, stride, 1,
+                        name=f"{name}double3x3b")))
+    pool_type, proj = pool
+    pool_mod = (nn.SpatialMaxPooling(3, 3, stride, stride,
+                                     0 if stride == 2 else 1,
+                                     0 if stride == 2 else 1,
+                                     ceil_mode=True)
+                if pool_type == "max"
+                else nn.SpatialAveragePooling(3, 3, stride, stride, 1, 1,
+                                              ceil_mode=True))
+    tower = nn.Sequential().add(pool_mod)
+    if proj > 0:
+        tower.add(_conv_bn(in_c, proj, 1, name=f"{name}pool_proj"))
+    m.add(tower)
+    return m
+
+
+def inception_v2(class_num: int = 1000) -> nn.Sequential:
+    """BN-Inception / Inception-v2 (reference
+    ``DL/models/inception/Inception_v2.scala:276`` no-aux variant)."""
+    m = (nn.Sequential(name="InceptionV2")
+         .add(_conv_bn(3, 64, 7, 2, 3, "conv1/7x7_s2"))
+         .add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True))
+         .add(_conv_bn(64, 64, 1, name="conv2/3x3_reduce"))
+         .add(_conv_bn(64, 192, 3, 1, 1, "conv2/3x3"))
+         .add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True)))
+    m.add(inception_layer_v2(192, 64, (64, 64), (64, 96), ("avg", 32),
+                             "3a/"))
+    m.add(inception_layer_v2(256, 64, (64, 96), (64, 96), ("avg", 64),
+                             "3b/"))
+    m.add(inception_layer_v2(320, 0, (128, 160), (64, 96), ("max", 0),
+                             "3c/"))
+    m.add(inception_layer_v2(576, 224, (64, 96), (96, 128), ("avg", 128),
+                             "4a/"))
+    m.add(inception_layer_v2(576, 192, (96, 128), (96, 128), ("avg", 128),
+                             "4b/"))
+    m.add(inception_layer_v2(576, 160, (128, 160), (128, 160), ("avg", 96),
+                             "4c/"))
+    m.add(inception_layer_v2(576, 96, (128, 192), (160, 192), ("avg", 96),
+                             "4d/"))
+    m.add(inception_layer_v2(576, 0, (128, 192), (192, 256), ("max", 0),
+                             "4e/"))
+    m.add(inception_layer_v2(1024, 352, (192, 320), (160, 224),
+                             ("avg", 128), "5a/"))
+    m.add(inception_layer_v2(1024, 352, (192, 320), (192, 224),
+                             ("max", 128), "5b/"))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1, ceil_mode=True))
+    m.add(nn.Reshape((1024,)))
+    m.add(nn.Linear(1024, class_num, weight_init=Xavier()))
+    m.add(nn.LogSoftMax())
+    return m

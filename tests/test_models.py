@@ -87,3 +87,18 @@ class TestZooGradients:
         p, _ = m.init(jax.random.PRNGKey(0))
         n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(p))
         assert 6_500_000 < n < 7_500_000, n
+
+
+def test_inception_v2_forward():
+    """BN-Inception topology (reference Inception_v2.scala no-aux):
+    channel widths check out through all 10 modules."""
+    from bigdl_tpu.models.inception import inception_v2
+    m = inception_v2(class_num=7)
+    m.initialize()
+    m.training = False
+    out = m.forward(np.zeros((1, 3, 224, 224), np.float32))
+    assert out.shape == (1, 7)
+    assert np.isfinite(np.asarray(out)).all()
+    # log-softmax output sums to 1 in prob space
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(), 1.0,
+                               rtol=1e-4)
