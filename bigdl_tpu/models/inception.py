@@ -72,7 +72,8 @@ def _conv_bn(in_c, out_c, k, stride=1, pad=0, name=""):
     ``Inception_v2.scala`` pairs every conv with SpatialBatchNormalization)."""
     return (nn.Sequential(name=name)
             .add(nn.SpatialConvolution(in_c, out_c, k, k, stride, stride,
-                                       pad, pad, weight_init=Xavier(),
+                                       pad, pad, with_bias=False,
+                                       weight_init=Xavier(),
                                        name=f"{name}_conv"))
             .add(nn.SpatialBatchNormalization(out_c, eps=1e-3,
                                               name=f"{name}/bn"))
@@ -144,6 +145,7 @@ def inception_v2(class_num: int = 1000) -> nn.Sequential:
     m.add(inception_layer_v2(1024, 352, (192, 320), (192, 224),
                              ("max", 128), "5b/"))
     m.add(nn.SpatialAveragePooling(7, 7, 1, 1, ceil_mode=True))
+    m.add(nn.Dropout(0.4))
     m.add(nn.Reshape((1024,)))
     m.add(nn.Linear(1024, class_num, weight_init=Xavier()))
     m.add(nn.LogSoftMax())

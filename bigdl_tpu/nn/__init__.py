@@ -56,6 +56,7 @@ from bigdl_tpu.nn.recurrent import (
 )
 from bigdl_tpu.nn.detection import (
     Anchor, Nms, nms, PriorBox, Proposal, RoiPooling, DetectionOutputSSD,
+    DetectionOutputFrcnn,
     bbox_transform_inv, clip_boxes, box_iou,
 )
 from bigdl_tpu.nn.tree import TreeLSTM, BinaryTreeLSTM

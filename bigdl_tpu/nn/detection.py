@@ -364,6 +364,22 @@ class RoiPooling(Module):
         return jnp.where(jnp.isfinite(out), out, 0.0), state
 
 
+def _global_topk(dets: jnp.ndarray, valid: jnp.ndarray, k: int):
+    """Keep the k highest-scoring rows of (dets (M, 6), valid (M,)),
+    zero-padding to static k (shared by the SSD/FRCNN output heads;
+    column 1 is the score)."""
+    masked = jnp.where(valid, dets[:, 1], -jnp.inf)
+    kk = min(k, masked.shape[0])
+    top_s, top_i = lax.top_k(masked, kk)
+    out = dets[top_i] * jnp.isfinite(top_s)[:, None]
+    out_valid = jnp.isfinite(top_s)
+    if kk < k:
+        pad = k - kk
+        out = jnp.concatenate([out, jnp.zeros((pad, 6))])
+        out_valid = jnp.concatenate([out_valid, jnp.zeros((pad,), bool)])
+    return out, out_valid
+
+
 # ------------------------------------------------------- DetectionOutputSSD
 class DetectionOutputSSD(Module):
     """SSD post-processing (reference ``DetectionOutputSSD.scala:49``).
@@ -432,18 +448,56 @@ class DetectionOutputSSD(Module):
                 all_valid.append(valid)
             dets = jnp.concatenate(all_dets)          # (C*per_class, 6)
             valid = jnp.concatenate(all_valid)
-            # keep the overall top-k by score
-            masked = jnp.where(valid, dets[:, 1], -jnp.inf)
-            k = min(self.keep_topk, masked.shape[0])
-            top_s, top_i = lax.top_k(masked, k)
-            out = dets[top_i] * jnp.isfinite(top_s)[:, None]
-            out_valid = jnp.isfinite(top_s)
-            if k < self.keep_topk:
-                pad = self.keep_topk - k
-                out = jnp.concatenate([out, jnp.zeros((pad, 6))])
-                out_valid = jnp.concatenate([out_valid,
-                                             jnp.zeros((pad,), bool)])
-            return out, out_valid
+            return _global_topk(dets, valid, self.keep_topk)
 
         dets, valid = jax.vmap(one_image)(loc, conf)
         return (dets, valid), state
+
+
+# --------------------------------------------------- DetectionOutputFrcnn
+class DetectionOutputFrcnn(Module):
+    """Faster-RCNN post-processing (reference
+    ``DetectionOutputFrcnn.scala:48``).  Input:
+    ``(im_info (1, >=4), rois (R, 5) [batch, x1, y1, x2, y2],
+    bbox_deltas (R, 4*n_classes), scores (R, n_classes))``.
+    Output: ``(dets (max_per_image, 6) = [label, score, x1, y1, x2, y2],
+    valid (max_per_image,))`` — static shapes, masked.
+
+    Unlike SSD's share_location head, every class has its OWN box
+    regression (per-class 4-delta slice), per-class NMS at ``nms_thresh``,
+    a score floor ``thresh``, and a global top-``max_per_image`` cut.
+    """
+
+    def __init__(self, nms_thresh: float = 0.3, n_classes: int = 21,
+                 max_per_image: int = 100, thresh: float = 0.05,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.nms_thresh = nms_thresh
+        self.n_classes = n_classes
+        self.max_per_image = max_per_image
+        self.thresh = thresh
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        im_info, rois, deltas, scores = input
+        R = rois.shape[0]
+        im_h, im_w = im_info[0, 0], im_info[0, 1]
+        boxes = rois[:, 1:5]
+        # faithful to the reference: per-class NMS is UNBOUNDED (every roi
+        # may survive); only the global max_per_image cut limits output
+        per_class = min(R, self.max_per_image)
+        all_dets, all_valid = [], []
+        for c in range(1, self.n_classes):  # 0 = background
+            d = deltas[:, 4 * c:4 * (c + 1)]
+            decoded = clip_boxes(bbox_transform_inv(boxes, d), im_h, im_w)
+            s = jnp.where(scores[:, c] > self.thresh, scores[:, c],
+                          -jnp.inf)
+            idx, valid = nms(decoded, s, self.nms_thresh, per_class)
+            b = decoded[jnp.maximum(idx, 0)]
+            sc = scores[jnp.maximum(idx, 0), c]
+            det = jnp.concatenate(
+                [jnp.full((per_class, 1), float(c)), sc[:, None], b], 1)
+            all_dets.append(det)
+            all_valid.append(valid)
+        dets = jnp.concatenate(all_dets)
+        valid = jnp.concatenate(all_valid)
+        return _global_topk(dets, valid, self.max_per_image), state

@@ -95,7 +95,8 @@ def main():
            >> V.ImageFrameToSample(to_chw=(fmt == "NCHW")))
 
     def augment(s):
-        f = V.ImageFeature(s.feature.astype(np.float32), s.label)
+        # ImageFeature casts to float32 itself; no extra copy here
+        f = V.ImageFeature(s.feature, s.label)
         return aug(f)["sample"]
 
     train_set = (DataSet.array(samples, distributed=args.distributed)

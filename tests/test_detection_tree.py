@@ -193,3 +193,43 @@ class TestBinaryTreeLSTM:
             p, jnp.asarray(emb))
         assert out.shape == (1, 7, 6)
         assert np.isfinite(np.asarray(out)).all()
+
+
+class TestDetectionOutputFrcnn:
+    def test_per_class_regression_and_nms(self):
+        C = 3
+        rois = jnp.array([[0, 10, 10, 30, 30],
+                          [0, 12, 12, 32, 32],
+                          [0, 60, 60, 80, 80]], jnp.float32)
+        R = 3
+        deltas = np.zeros((R, 4 * C), np.float32)
+        # class 2 shifts box 2 by +5 in x (dx = 5/width)
+        deltas[2, 8] = 5.0 / 21.0
+        scores = np.full((R, C), 0.01, np.float32)
+        scores[0, 1] = 0.9
+        scores[1, 1] = 0.85   # overlapping with roi 0 -> suppressed
+        scores[2, 2] = 0.7
+        det = nn.DetectionOutputFrcnn(n_classes=C, max_per_image=6,
+                                      thresh=0.05)
+        im_info = jnp.array([[100.0, 100.0, 1.0, 1.0]])
+        (dets, valid), _ = det.apply(
+            {}, {}, (im_info, rois, jnp.asarray(deltas),
+                     jnp.asarray(scores)))
+        v = np.asarray(dets)[np.asarray(valid)]
+        assert len(v) == 2
+        np.testing.assert_allclose(v[0, :2], [1, 0.9], rtol=1e-5)
+        np.testing.assert_allclose(v[1, :2], [2, 0.7], rtol=1e-5)
+        # class-2 regression applied (+5 x shift on roi 2)
+        np.testing.assert_allclose(v[1, 2], 65.0, atol=0.6)
+
+    def test_static_shape_under_jit(self):
+        C, R = 4, 8
+        det = nn.DetectionOutputFrcnn(n_classes=C, max_per_image=9)
+        f = jax.jit(lambda a, b, c, d: det.apply({}, {}, (a, b, c, d))[0])
+        rng = np.random.RandomState(0)
+        out, valid = f(jnp.array([[50.0, 50, 1, 1]]),
+                       jnp.asarray(rng.rand(R, 5).astype(np.float32) * 40),
+                       jnp.asarray((rng.rand(R, 4 * C) - 0.5).astype(
+                           np.float32) * 0.1),
+                       jnp.asarray(rng.rand(R, C).astype(np.float32)))
+        assert out.shape == (9, 6) and valid.shape == (9,)
