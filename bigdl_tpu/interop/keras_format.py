@@ -97,24 +97,86 @@ def _layer_from_config(entry: Dict[str, Any]):
         "converter.py LAYER mapping)")
 
 
-def load_keras_json(json_str_or_path: str) -> "K.Sequential":
-    """Keras-1.2 ``model.to_json()`` → :class:`bigdl_tpu.keras.Sequential`
-    (reference ``DefinitionLoader.from_json_path``)."""
+def load_keras_json(json_str_or_path: str):
+    """Keras-1.2 ``model.to_json()`` → topology (reference
+    ``DefinitionLoader.from_json_path``).  ``Sequential`` JSON gives a
+    :class:`bigdl_tpu.keras.Sequential`; functional ``Model`` JSON gives a
+    core :class:`bigdl_tpu.nn.Graph` wrapped in ``keras.Model``."""
     text = json_str_or_path
     if not text.lstrip().startswith("{"):
         with open(json_str_or_path) as f:
             text = f.read()
     doc = json.loads(text)
     cls = doc.get("class_name")
-    if cls != "Sequential":
-        raise NotImplementedError(
-            f"Keras model class {cls!r}: only Sequential JSON is "
-            "supported (functional Model graphs: build with "
-            "bigdl_tpu.keras directly)")
-    model = K.Sequential()
-    for entry in doc.get("config", []):
-        model.add(_layer_from_config(entry))
-    return model
+    if cls == "Sequential":
+        model = K.Sequential()
+        for entry in doc.get("config", []):
+            model.add(_layer_from_config(entry))
+        return model
+    if cls == "Model":
+        return _load_functional_model(doc["config"])
+    raise NotImplementedError(f"Keras model class {cls!r}")
+
+
+def _load_functional_model(cfg: dict) -> "K.Model":
+    """Functional-API graph: layers connected by ``inbound_nodes``
+    (reference converter's Model path).  Each deferred wrapper builds
+    once its input shape is known, walked in topological (listed) order;
+    edges become ``nn.Graph`` nodes.  Multi-input layers (Merge) receive
+    a node list."""
+    from bigdl_tpu.keras.layers import infer_output_shape
+    from bigdl_tpu.nn.graph import Graph, Input as GInput
+
+    nodes: Dict[str, Any] = {}
+    shapes: Dict[str, tuple] = {}
+    inputs = []
+    for entry in cfg.get("layers", []):
+        name = entry.get("name") or entry["config"].get("name")
+        lcls = entry["class_name"]
+        inbound = entry.get("inbound_nodes") or []
+        if len(inbound) > 1:
+            raise NotImplementedError(
+                f"layer {name!r} is called {len(inbound)} times (shared "
+                "layer); multi-call functional graphs are not supported")
+        srcs = [ib[0] for ib in inbound[0]] if inbound else []
+        if lcls == "InputLayer":
+            n = GInput()
+            nodes[name] = n
+            bis = entry["config"].get("batch_input_shape")
+            shapes[name] = tuple(int(d) for d in (bis or [None])[1:])
+            inputs.append(n)
+            continue
+        if lcls == "Merge":
+            cfg_m = entry["config"]
+            mode = cfg_m.get("mode", "sum")
+            axis = int(cfg_m.get("concat_axis", -1))
+            core = K.Merge(mode=mode, concat_axis=axis).build(None)
+            in_nodes = [nodes[s] for s in srcs]
+            nodes[name] = core(in_nodes)
+            s0 = shapes[srcs[0]]
+            if mode == "concat":
+                # Keras concat_axis counts the batch dim; our bookkeeping
+                # shapes are batch-less, so positive axes shift down by 1
+                ax = axis - 1 if axis > 0 else len(s0) + axis
+                cat = list(s0)
+                cat[ax] = sum(shapes[s][ax] for s in srcs)
+                shapes[name] = tuple(cat)
+            else:
+                shapes[name] = s0
+            continue
+        wrapper = _layer_from_config(entry)
+        if len(srcs) != 1:
+            raise NotImplementedError(
+                f"layer {name!r} ({lcls}) with {len(srcs)} inbound nodes")
+        in_shape = shapes[srcs[0]]
+        core = wrapper.build(in_shape)
+        shapes[name] = infer_output_shape(core, in_shape)
+        nodes[name] = core(nodes[srcs[0]])
+
+    out_names = [o[0] for o in cfg.get("output_layers", [])]
+    graph = Graph(inputs, [nodes[o] for o in out_names],
+                  name=cfg.get("name", "KerasModel"))
+    return K.Model(graph)
 
 
 def set_keras_weights(model: "K.Sequential",

@@ -511,3 +511,116 @@ class TestTFSession:
         probs = sess.run(imgs[:32])
         acc = (np.argmax(probs, -1) == labels[:32]).mean()
         assert acc > 0.7, acc
+
+
+class TestKerasFunctionalModel:
+    def _doc(self, mode="concat"):
+        import json
+        return json.dumps({
+            "class_name": "Model",
+            "config": {
+                "name": "branchy",
+                "layers": [
+                    {"class_name": "InputLayer", "name": "in1",
+                     "config": {"name": "in1",
+                                "batch_input_shape": [None, 6]}},
+                    {"class_name": "Dense", "name": "a",
+                     "config": {"name": "a", "output_dim": 8,
+                                "activation": "relu"},
+                     "inbound_nodes": [[["in1", 0, 0]]]},
+                    {"class_name": "Dense", "name": "b",
+                     "config": {"name": "b", "output_dim": 8,
+                                "activation": "tanh"},
+                     "inbound_nodes": [[["in1", 0, 0]]]},
+                    {"class_name": "Merge", "name": "m",
+                     "config": {"name": "m", "mode": mode,
+                                "concat_axis": -1},
+                     "inbound_nodes": [[["a", 0, 0], ["b", 0, 0]]]},
+                    {"class_name": "Dense", "name": "out",
+                     "config": {"name": "out", "output_dim": 3,
+                                "activation": "softmax"},
+                     "inbound_nodes": [[["m", 0, 0]]]},
+                ],
+                "input_layers": [["in1", 0, 0]],
+                "output_layers": [["out", 0, 0]],
+            }})
+
+    def test_branching_model_imports_and_runs(self):
+        from bigdl_tpu.interop import load_keras_json
+        m = load_keras_json(self._doc())
+        core = m.core_module()
+        x = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+        out = np.asarray(core.forward(x))
+        assert out.shape == (4, 3)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+    def test_sum_merge_and_training(self):
+        from bigdl_tpu.interop import load_keras_json
+        from bigdl_tpu import optim
+        m = load_keras_json(self._doc(mode="sum"))
+        rng = np.random.RandomState(1)
+        centers = rng.randn(3, 6) * 4
+        y = rng.randint(0, 3, 192)
+        x = (centers[y] + rng.randn(192, 6)).astype(np.float32)
+        m.compile(optim.Adam(learning_rate=0.01),
+                  "categorical_crossentropy", ["accuracy"])
+        m.fit(x, y, batch_size=32, nb_epoch=10)
+        assert m.evaluate(x, y)["Top1Accuracy"] > 0.9
+
+
+def test_keras_functional_positive_concat_axis():
+    """Regression: Keras concat_axis counts the batch dim; positive axes
+    must shift when indexing batch-less bookkeeping shapes."""
+    import json
+    from bigdl_tpu.interop import load_keras_json
+    doc = json.dumps({
+        "class_name": "Model",
+        "config": {
+            "name": "chan_concat",
+            "layers": [
+                {"class_name": "InputLayer", "name": "in1",
+                 "config": {"name": "in1",
+                            "batch_input_shape": [None, 3, 8, 8]}},
+                {"class_name": "Convolution2D", "name": "ca",
+                 "config": {"name": "ca", "nb_filter": 4, "nb_row": 3,
+                            "nb_col": 3, "border_mode": "same"},
+                 "inbound_nodes": [[["in1", 0, 0]]]},
+                {"class_name": "Convolution2D", "name": "cb",
+                 "config": {"name": "cb", "nb_filter": 5, "nb_row": 3,
+                            "nb_col": 3, "border_mode": "same"},
+                 "inbound_nodes": [[["in1", 0, 0]]]},
+                {"class_name": "Merge", "name": "m",
+                 "config": {"name": "m", "mode": "concat",
+                            "concat_axis": 1},
+                 "inbound_nodes": [[["ca", 0, 0], ["cb", 0, 0]]]},
+                {"class_name": "Convolution2D", "name": "out",
+                 "config": {"name": "out", "nb_filter": 2, "nb_row": 1,
+                            "nb_col": 1},
+                 "inbound_nodes": [[["m", 0, 0]]]},
+            ],
+            "input_layers": [["in1", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        }})
+    m = load_keras_json(doc)
+    out = m.core_module().forward(np.zeros((2, 3, 8, 8), np.float32))
+    assert out.shape == (2, 2, 8, 8)
+
+
+def test_keras_functional_shared_layer_rejected():
+    import json
+    from bigdl_tpu.interop import load_keras_json
+    doc = json.dumps({
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "i",
+                 "config": {"name": "i", "batch_input_shape": [None, 4]}},
+                {"class_name": "Dense", "name": "d",
+                 "config": {"name": "d", "output_dim": 4},
+                 "inbound_nodes": [[["i", 0, 0]], [["d", 0, 0]]]},
+            ],
+            "input_layers": [["i", 0, 0]],
+            "output_layers": [["d", 1, 0]],
+        }})
+    with pytest.raises(NotImplementedError, match="shared"):
+        load_keras_json(doc)
