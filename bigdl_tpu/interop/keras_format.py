@@ -22,6 +22,17 @@ import numpy as np
 from bigdl_tpu import keras as K
 
 
+def _batchless_shape(bis) -> tuple:
+    """batch_input_shape → batch-less tuple, rejecting dynamic dims with
+    a clear message (None in non-batch positions)."""
+    dims = bis[1:]
+    if any(d is None for d in dims):
+        raise NotImplementedError(
+            f"dynamic (null) input dimensions {bis} are not supported; "
+            "fix the shape in the Keras config before import")
+    return tuple(int(d) for d in dims)
+
+
 def _layer_from_config(entry: Dict[str, Any]):
     cls = entry["class_name"]
     cfg = entry.get("config", {})
@@ -29,7 +40,7 @@ def _layer_from_config(entry: Dict[str, Any]):
     def input_shape():
         bis = cfg.get("batch_input_shape")
         if bis:
-            return tuple(int(d) for d in bis[1:])
+            return _batchless_shape(bis)
         if cfg.get("input_dim"):
             return (int(cfg["input_dim"]),)
         return None
@@ -129,7 +140,6 @@ def _load_functional_model(cfg: dict) -> "K.Model":
 
     nodes: Dict[str, Any] = {}
     shapes: Dict[str, tuple] = {}
-    inputs = []
     for entry in cfg.get("layers", []):
         name = entry.get("name") or entry["config"].get("name")
         lcls = entry["class_name"]
@@ -143,8 +153,7 @@ def _load_functional_model(cfg: dict) -> "K.Model":
             n = GInput()
             nodes[name] = n
             bis = entry["config"].get("batch_input_shape")
-            shapes[name] = tuple(int(d) for d in (bis or [None])[1:])
-            inputs.append(n)
+            shapes[name] = _batchless_shape(bis or [None])
             continue
         if lcls == "Merge":
             cfg_m = entry["config"]
@@ -173,6 +182,14 @@ def _load_functional_model(cfg: dict) -> "K.Model":
         shapes[name] = infer_output_shape(core, in_shape)
         nodes[name] = core(nodes[srcs[0]])
 
+    # bind inputs in the DECLARED order (cfg["input_layers"]), which may
+    # differ from the layer-listing order Keras serializes
+    in_names = [i[0] for i in cfg.get("input_layers", [])]
+    if not in_names:  # fall back to listing order
+        in_names = [e.get("name") or e["config"].get("name")
+                    for e in cfg.get("layers", [])
+                    if e["class_name"] == "InputLayer"]
+    inputs = [nodes[i] for i in in_names]
     out_names = [o[0] for o in cfg.get("output_layers", [])]
     graph = Graph(inputs, [nodes[o] for o in out_names],
                   name=cfg.get("name", "KerasModel"))

@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.interop.tf_loops import extract_frames
 from bigdl_tpu.ops import get_op
 from bigdl_tpu.utils import protowire as pw
 
@@ -403,6 +404,13 @@ class TFGraphModule(Module):
         self.input_names = list(inputs)
         self.output_names = list(outputs)
         self._var_init: Dict[str, np.ndarray] = {}
+        # while-loop frames (Enter/Merge/Switch/Exit wiring -> one
+        # lax.while_loop each; see interop/tf_loops.py)
+        self._frames = extract_frames(nodes)
+        self._node_frame: Dict[str, "object"] = {}
+        for fr in self._frames.values():
+            for nm in fr.interior:
+                self._node_frame[nm] = fr
 
         # prune: reverse DFS from outputs (reference buildTFGraph:201).
         # Nodes named in ``inputs`` become feed points whatever their op —
@@ -423,6 +431,21 @@ class TFGraphModule(Module):
             needed.append(nm)
             if node["op"] in ("Placeholder", "PlaceholderV2") \
                     or nm in feed_points:
+                continue
+            if nm in self._node_frame and self._node_frame[nm].error:
+                raise NotImplementedError(self._node_frame[nm].error)
+            if node["op"] == "Exit" and nm in self._node_frame:
+                # pull the whole frame + every external input it reads
+                fr = self._node_frame[nm]
+                for inm in fr.interior:
+                    if inm not in seen:
+                        seen.add(inm)
+                        needed.append(inm)
+                for inm in fr.interior:
+                    for inp in self.by_name[inm]["inputs"]:
+                        b, ix = _base_name(inp)
+                        if ix >= 0 and b not in fr.interior:
+                            stack.append(b)
                 continue
             for inp in node["inputs"]:
                 b, ix = _base_name(inp)
@@ -461,15 +484,27 @@ class TFGraphModule(Module):
                                  "the DynamicGraph scheduler)")
             state[nm] = 1
             node = self.by_name[nm]
-            if node["op"] not in ("Placeholder", "PlaceholderV2",
-                                  "VariableV2", "Variable", "Const") \
+            fr = self._node_frame.get(nm)
+            if fr is not None and node["op"] == "Exit":
+                # an Exit depends on every EXTERNAL input of its frame
+                for inm in fr.interior:
+                    for inp in self.by_name[inm]["inputs"]:
+                        b, ix = _base_name(inp)
+                        if ix >= 0 and b not in fr.interior \
+                                and b in self.needed:
+                            visit(b)
+            elif fr is not None:
+                pass  # interior nodes execute inside the frame's while
+            elif node["op"] not in ("Placeholder", "PlaceholderV2",
+                                    "VariableV2", "Variable", "Const") \
                     and nm not in self.feed_points:
                 for inp in node["inputs"]:
                     b, ix = _base_name(inp)
                     if ix >= 0 and b in self.needed:
                         visit(b)
             state[nm] = 2
-            order.append(nm)
+            if fr is None or node["op"] == "Exit":
+                order.append(nm)
 
         import sys
         old = sys.getrecursionlimit()
@@ -500,7 +535,8 @@ class TFGraphModule(Module):
             if op == "Const":
                 folded[nm] = np.asarray(node["attrs"]["value"])
                 continue
-            if op in dynamic_ops or nm in self.feed_points:
+            if op in dynamic_ops or nm in self.feed_points \
+                    or nm in self._node_frame:
                 continue
             args = []
             ok = True
@@ -553,6 +589,110 @@ class TFGraphModule(Module):
             return None
         return None if isinstance(out, tuple) else np.asarray(out)
 
+    # ----------------------------------------------------- while frames
+    def _eval_interior(self, fr, bind, values, target: str,
+                       memo: Optional[Dict[str, Any]] = None):
+        """Evaluate interior node ``target`` with Merge/invariant-Enter
+        nodes bound via ``bind`` and exterior values from ``values``.
+        Pass one ``memo`` across several targets of the same invocation so
+        shared body subgraphs trace once, not once per loop variable."""
+        if memo is None:
+            memo = {}
+
+        def ev(nm: str):
+            if nm in memo:
+                return memo[nm]
+            if nm in bind:
+                memo[nm] = bind[nm]
+                return bind[nm]
+            if nm not in fr.interior:
+                return values[nm]
+            node = self.by_name[nm]
+            op = node["op"]
+            if op in ("Merge",):  # bound above; a Merge not in bind is odd
+                raise NotImplementedError(
+                    f"unbound Merge {nm} in while frame {fr.name}")
+            if op in ("Switch", "LoopCond", "Identity", "NextIteration",
+                      "Enter"):
+                out = ev(_base_name(node["inputs"][0])[0])
+                memo[nm] = out
+                return out
+            args = []
+            for inp in node["inputs"]:
+                b, ix = _base_name(inp)
+                if ix < 0:
+                    continue
+                v = ev(b)
+                args.append(v[ix] if isinstance(v, tuple) else v)
+            out = get_op(op)({**node["attrs"], "_node_name": nm}, *args)
+            memo[nm] = out
+            return out
+
+        return ev(_base_name(target)[0])
+
+    def _run_frame(self, fr, values) -> None:
+        """Execute one while frame with lax.while_loop; store every
+        Exit's value into ``values``."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        # initial carry: the Enter inputs (outer values), merge-ordered
+        carry0 = tuple(
+            jnp.asarray(values[_base_name(e["inputs"][0])[0]])
+            for e in fr.enters)
+        invariant_bind = {
+            inv["name"]: values[_base_name(inv["inputs"][0])[0]]
+            for inv in fr.invariants}
+
+        def bindings(carry):
+            bind = dict(invariant_bind)
+            for m, c in zip(fr.merges, carry):
+                bind[m["name"]] = c
+            return bind
+
+        def cond(carry):
+            b = self._eval_interior(fr, bindings(carry), values,
+                                    fr.loop_cond["inputs"][0])
+            return jnp.reshape(jnp.asarray(b, bool), ())
+
+        # map each NextIteration to its loop variable (via its Merge)
+        nextit_of_merge = {}
+        for m, e in zip(fr.merges, fr.enters):
+            for inp in m["inputs"]:
+                bse = _base_name(inp)[0]
+                if bse != e["name"]:
+                    nextit_of_merge[m["name"]] = self.by_name[bse]
+
+        def body(carry):
+            bind = bindings(carry)
+            memo: Dict[str, Any] = {}
+            outs = []
+            for m, c in zip(fr.merges, carry):
+                ni = nextit_of_merge.get(m["name"])
+                if ni is None:
+                    outs.append(c)
+                    continue
+                v = self._eval_interior(fr, bind, values,
+                                        ni["inputs"][0], memo)
+                outs.append(jnp.asarray(v, c.dtype).reshape(c.shape))
+            return tuple(outs)
+
+        final = lax.while_loop(cond, body, carry0)
+
+        # each Exit's input chains (through Switch:0) to a Merge
+        merge_ix = {m["name"]: i for i, m in enumerate(fr.merges)}
+        for ex in fr.exits:
+            nm = _base_name(ex["inputs"][0])[0]
+            # walk passthroughs until a Merge
+            hops = 0
+            while nm not in merge_ix and hops < 16:
+                nm = _base_name(self.by_name[nm]["inputs"][0])[0]
+                hops += 1
+            if nm not in merge_ix:
+                raise NotImplementedError(
+                    f"Exit {ex['name']} does not trace to a loop variable")
+            values[ex["name"]] = final[merge_ix[nm]]
+
     # ---------------------------------------------------------------- API
     def init(self, rng):
         import jax.numpy as jnp
@@ -580,6 +720,9 @@ class TFGraphModule(Module):
                 values[nm] = self._folded[nm]
             elif op in ("VariableV2", "Variable"):
                 values[nm] = params[nm]
+            elif op == "Exit" and nm in self._node_frame:
+                if nm not in values:  # first Exit runs the whole frame
+                    self._run_frame(self._node_frame[nm], values)
             else:
                 args = []
                 for inp in node["inputs"]:
@@ -590,9 +733,8 @@ class TFGraphModule(Module):
                     args.append(v[ix] if isinstance(v, tuple) else v)
                 if op in ("Enter", "Exit", "NextIteration", "LoopCond"):
                     raise NotImplementedError(
-                        f"TF while-loop frame op {op!r} ({nm}): loop "
-                        "import is not supported (conditionals via "
-                        "Switch/Merge are)")
+                        f"stray while-frame op {op!r} ({nm}) outside a "
+                        "recognized loop frame")
                 if op == "Switch":
                     pred_name = _base_name(node["inputs"][1])[0]
                     values[nm] = _exec_switch(args, pred_name)

@@ -1,0 +1,144 @@
+"""TF v1 while-loop frame reconstruction → ``lax.while_loop``.
+
+Reference: ``DL/nn/tf/ControlOps.scala`` (Enter/Exit/NextIteration/
+LoopCondition/Switch/Merge) executed by the dataflow ``Scheduler``
+(``DL/nn/Scheduler.scala:104-145``) with dead-token propagation.
+
+TPU redesign: a loop frame compiles to ONE ``lax.while_loop``.  The v1
+wiring per loop variable is
+
+    outer ──Enter(frame)──▶ Merge ◀── NextIteration ◀── body value
+                              │
+                              ├──▶ (cond subgraph) ──▶ LoopCond
+                              ▼
+                           Switch(data, LoopCond)
+                        port0=false ▶ Exit ▶ downstream
+                        port1=true  ▶ (body subgraph)
+
+so: carry = Merge values; ``cond`` evaluates the LoopCond input with
+merges bound to the carry; ``body`` evaluates each NextIteration input
+the same way; Exit yields the final carry.  Loop-invariant Enters (no
+Merge consumer) bind straight to their outer value.
+
+Imported loops are forward-only under reverse-mode AD (lax.while_loop
+with a dynamic trip count is not reverse-differentiable) — the same
+contract as the reference, whose ``nn/ops`` control-flow execution is
+forward-only.
+
+:func:`extract_frames` groups a GraphDef's nodes by the Enter
+``frame_name`` attr and returns the per-frame wiring; the executor in
+``tf_format`` uses it to run frames as single fused steps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+def _attr_frame(node) -> Optional[str]:
+    f = node["attrs"].get("frame_name")
+    if isinstance(f, bytes):
+        return f.decode()
+    return f
+
+
+class LoopFrame:
+    """Wiring of one while-loop frame."""
+
+    __slots__ = ("name", "interior", "enters", "merges", "switches",
+                 "exits", "next_iterations", "loop_cond", "invariants",
+                 "error")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.error: Optional[str] = None  # set instead of raising so an
+        # UNREACHABLE malformed frame never blocks loading; the executor
+        # raises only if a pruned path actually needs this frame
+        self.interior: set = set()      # node names inside the frame
+        self.enters: List[dict] = []
+        self.merges: List[dict] = []    # aligned with loop-var enters
+        self.switches: List[dict] = []
+        self.exits: List[dict] = []
+        self.next_iterations: List[dict] = []
+        self.loop_cond: Optional[dict] = None
+        self.invariants: List[dict] = []  # Enters with no Merge consumer
+
+
+def extract_frames(nodes: List[dict]) -> Dict[str, LoopFrame]:
+    """Group control-flow nodes into frames and recover per-variable
+    wiring.  Unsupported shapes (nested frames, missing LoopCond, odd
+    merge wiring) set ``frame.error`` rather than raising, so they only
+    fail if the requested outputs actually reach them."""
+    by_name = {n["name"]: n for n in nodes}
+    consumers: Dict[str, List[dict]] = {}
+    for n in nodes:
+        for inp in n["inputs"]:
+            base = inp.split(":")[0].lstrip("^")
+            consumers.setdefault(base, []).append(n)
+
+    frames: Dict[str, LoopFrame] = {}
+    for n in nodes:
+        if n["op"] == "Enter":
+            fname = _attr_frame(n) or "frame"
+            frames.setdefault(fname, LoopFrame(fname)).enters.append(n)
+
+    for frame in frames.values():
+        # frame membership: flood from the Enters forward until Exit
+        stack = [e["name"] for e in frame.enters]
+        seen = set(stack)
+        while stack:
+            nm = stack.pop()
+            node = by_name[nm]
+            frame.interior.add(nm)
+            if node["op"] == "Exit":
+                continue
+            for c in consumers.get(nm, []):
+                if c["name"] not in seen:
+                    seen.add(c["name"])
+                    stack.append(c["name"])
+        for nm in frame.interior:
+            node = by_name[nm]
+            op = node["op"]
+            if op == "Merge":
+                frame.merges.append(node)
+            elif op == "Switch":
+                frame.switches.append(node)
+            elif op == "Exit":
+                frame.exits.append(node)
+            elif op == "NextIteration":
+                frame.next_iterations.append(node)
+            elif op == "LoopCond":
+                frame.loop_cond = node
+            elif op == "Enter" and (_attr_frame(node) or "frame") \
+                    != frame.name:
+                frame.error = (f"nested while-loop frames ({frame.name} "
+                               f"contains {_attr_frame(node)})")
+
+        # classify enters: loop variables feed a Merge; invariants don't
+        merge_inputs = {inp.split(":")[0]
+                        for m in frame.merges for inp in m["inputs"]}
+        loop_vars = []
+        for e in frame.enters:
+            (loop_vars if e["name"] in merge_inputs
+             else frame.invariants).append(e)
+        frame.enters = loop_vars
+        if frame.loop_cond is None:
+            frame.error = frame.error or (
+                f"while frame {frame.name!r} has no LoopCond")
+            continue
+
+        # order merges to match their enter (merge inputs: [enter, nextit])
+        enter_names = {e["name"]: i for i, e in enumerate(frame.enters)}
+        ordered = [None] * len(frame.enters)
+        for m in frame.merges:
+            for inp in m["inputs"]:
+                b = inp.split(":")[0]
+                if b in enter_names:
+                    ordered[enter_names[b]] = m
+        if any(o is None for o in ordered):
+            frame.error = frame.error or (
+                f"while frame {frame.name!r}: merge/enter wiring "
+                "unrecognized")
+            continue
+        frame.merges = ordered
+    return frames

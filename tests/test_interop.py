@@ -624,3 +624,107 @@ def test_keras_functional_shared_layer_rejected():
         }})
     with pytest.raises(NotImplementedError, match="shared"):
         load_keras_json(doc)
+
+
+class TestTFWhileLoopImport:
+    def _while_graph(self, tmp_path):
+        from bigdl_tpu.utils import protowire as pw
+
+        def enter(name, inputs, frame):
+            body = pw.enc_str(1, name) + pw.enc_str(2, "Enter")
+            for i in inputs:
+                body += pw.enc_str(3, i)
+            body += pw.enc_bytes(
+                5, pw.enc_str(1, "frame_name")
+                + pw.enc_bytes(2, pw.enc_bytes(2, frame.encode())))
+            return pw.enc_bytes(1, body)
+
+        # while (i < 5): i += 1; acc *= 2
+        g = (node("i0", "Placeholder")
+             + node("acc0", "Placeholder")
+             + enter("i_ent", ["i0"], "loop")
+             + enter("acc_ent", ["acc0"], "loop")
+             + node("i_mrg", "Merge", ["i_ent", "i_nextit"])
+             + node("acc_mrg", "Merge", ["acc_ent", "acc_nextit"])
+             + node("five", "Const", value=scalar_const(5.0))
+             + node("lt", "Less", ["i_mrg", "five"])
+             + node("lc", "LoopCond", ["lt"])
+             + node("i_sw", "Switch", ["i_mrg", "lc"])
+             + node("acc_sw", "Switch", ["acc_mrg", "lc"])
+             + node("one", "Const", value=scalar_const(1.0))
+             + node("two", "Const", value=scalar_const(2.0))
+             + node("i_add", "Add", ["i_sw:1", "one"])
+             + node("acc_mul", "Mul", ["acc_sw:1", "two"])
+             + node("i_nextit", "NextIteration", ["i_add"])
+             + node("acc_nextit", "NextIteration", ["acc_mul"])
+             + node("i_exit", "Exit", ["i_sw:0"])
+             + node("acc_exit", "Exit", ["acc_sw:0"])
+             + node("out", "Identity", ["acc_exit"]))
+        p = str(tmp_path / "while.pb")
+        open(p, "wb").write(g)
+        return p
+
+    def test_two_variable_loop(self, tmp_path):
+        m = load_tf_graph(self._while_graph(tmp_path),
+                          inputs=["i0", "acc0"],
+                          outputs=["out", "i_exit"])
+        (acc, i_final), _ = m.apply({}, {}, {"i0": np.float32(0.0),
+                                             "acc0": np.float32(3.0)})
+        assert float(acc) == 96.0     # 3 * 2^5
+        assert float(i_final) == 5.0
+
+    def test_loop_under_jit_with_traced_inputs(self, tmp_path):
+        m = load_tf_graph(self._while_graph(tmp_path),
+                          inputs=["i0", "acc0"],
+                          outputs=["out", "i_exit"])
+        f = jax.jit(lambda i, a: m.apply({}, {},
+                                         {"i0": i, "acc0": a})[0])
+        acc, i_final = f(np.float32(2.0), np.float32(1.0))
+        assert float(acc) == 8.0      # 1 * 2^3
+        assert float(i_final) == 5.0
+
+
+def test_unreachable_malformed_frame_tolerated(tmp_path):
+    """Regression: a broken loop frame OUTSIDE the requested subgraph must
+    not block import (real v1 graphs carry training-only loops)."""
+    g = (node("x", "Placeholder")
+         + node("y", "Identity", ["x"])
+         + node("stray", "Enter", ["x"]))   # malformed frame, unreachable
+    p = str(tmp_path / "g.pb")
+    open(p, "wb").write(g)
+    m = load_tf_graph(p, inputs=["x"], outputs=["y"])
+    out = np.asarray(m.forward(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_keras_functional_input_layers_order(tmp_path):
+    """Regression: inputs bind in cfg['input_layers'] order, not layer
+    listing order."""
+    import json
+    from bigdl_tpu.interop import load_keras_json
+    doc = json.dumps({
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "in_a",
+                 "config": {"name": "in_a",
+                            "batch_input_shape": [None, 2]}},
+                {"class_name": "InputLayer", "name": "in_b",
+                 "config": {"name": "in_b",
+                            "batch_input_shape": [None, 2]}},
+                {"class_name": "Merge", "name": "m",
+                 "config": {"name": "m", "mode": "sum"},
+                 "inbound_nodes": [[["in_a", 0, 0], ["in_b", 0, 0]]]},
+            ],
+            # declared order REVERSED vs listing order; output = in_a
+            # alone, so a swapped binding is directly observable
+            "input_layers": [["in_b", 0, 0], ["in_a", 0, 0]],
+            "output_layers": [["in_a", 0, 0]],
+        }})
+    m = load_keras_json(doc)
+    core = m.core_module()
+    a = np.full((1, 2), 10.0, np.float32)
+    b = np.full((1, 2), 1.0, np.float32)
+    # positional feed follows the DECLARED order: (in_b, in_a)
+    out = core.forward((b, a))
+    np.testing.assert_allclose(np.asarray(out), 10.0)
