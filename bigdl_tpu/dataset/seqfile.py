@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import os
 import struct
+import zlib
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -29,6 +30,7 @@ import numpy as np
 _VERSION = 6
 TEXT = "org.apache.hadoop.io.Text"
 BYTES_WRITABLE = "org.apache.hadoop.io.BytesWritable"
+DEFAULT_CODEC = "org.apache.hadoop.io.compress.DefaultCodec"
 
 
 # ----------------------------------------------------------- hadoop VInt
@@ -104,10 +106,16 @@ def read_seqfile(path: str) -> Iterator[Tuple[bytes, bytes]]:
         val_cls = _read_hadoop_string(f)
         compressed = f.read(1)[0] != 0
         block = f.read(1)[0] != 0
-        if compressed or block:
+        codec = None
+        if compressed:
+            codec = _read_hadoop_string(f)
+            if codec != DEFAULT_CODEC:
+                raise NotImplementedError(
+                    f"SequenceFile codec {codec!r}: only DefaultCodec "
+                    "(zlib) record compression is supported")
+        if block:
             raise NotImplementedError(
-                "compressed SequenceFiles are not supported (the reference "
-                "generator writes uncompressed)")
+                "block-compressed SequenceFiles are not supported")
         (meta_count,) = struct.unpack(">i", f.read(4))
         for _ in range(meta_count):
             _read_hadoop_string(f)
@@ -136,14 +144,19 @@ def read_seqfile(path: str) -> Iterator[Tuple[bytes, bytes]]:
             value = f.read(rec_len - key_len)
             if len(key) != key_len or len(value) != rec_len - key_len:
                 raise IOError(f"truncated SequenceFile record in {path}")
+            if compressed:
+                # record compression: the VALUE payload is deflated
+                value = zlib.decompress(value)
             yield decode(key_cls, key), decode(val_cls, value)
 
 
 def write_seqfile(path: str, records: Sequence[Tuple[bytes, bytes]],
                   key_cls: str = TEXT, val_cls: str = TEXT,
-                  sync_interval: int = 100) -> None:
-    """Write (key, value) byte pairs as an uncompressed SequenceFile
-    (``BGRImgToLocalSeqFile`` analog)."""
+                  sync_interval: int = 100,
+                  compressed: bool = False) -> None:
+    """Write (key, value) byte pairs as a SequenceFile
+    (``BGRImgToLocalSeqFile`` analog); ``compressed=True`` uses Hadoop
+    record compression with DefaultCodec (zlib) on the values."""
     sync = np.random.default_rng(12345).bytes(16)
 
     def encode(cls, payload: bytes) -> bytes:
@@ -157,7 +170,9 @@ def write_seqfile(path: str, records: Sequence[Tuple[bytes, bytes]],
         f.write(b"SEQ" + bytes([_VERSION]))
         f.write(_hadoop_string(key_cls))
         f.write(_hadoop_string(val_cls))
-        f.write(bytes([0, 0]))          # no compression
+        f.write(bytes([1 if compressed else 0, 0]))
+        if compressed:
+            f.write(_hadoop_string(DEFAULT_CODEC))
         f.write(struct.pack(">i", 0))   # no metadata
         f.write(sync)
         for i, (k, v) in enumerate(records):
@@ -166,6 +181,8 @@ def write_seqfile(path: str, records: Sequence[Tuple[bytes, bytes]],
                 f.write(sync)
             ke = encode(key_cls, k)
             ve = encode(val_cls, v)
+            if compressed:
+                ve = zlib.compress(ve)
             f.write(struct.pack(">i", len(ke) + len(ve)))
             f.write(struct.pack(">i", len(ke)))
             f.write(ke)

@@ -441,11 +441,7 @@ class TFGraphModule(Module):
                     if inm not in seen:
                         seen.add(inm)
                         needed.append(inm)
-                for inm in fr.interior:
-                    for inp in self.by_name[inm]["inputs"]:
-                        b, ix = _base_name(inp)
-                        if ix >= 0 and b not in fr.interior:
-                            stack.append(b)
+                stack.extend(fr.externals)
                 continue
             for inp in node["inputs"]:
                 b, ix = _base_name(inp)
@@ -487,12 +483,9 @@ class TFGraphModule(Module):
             fr = self._node_frame.get(nm)
             if fr is not None and node["op"] == "Exit":
                 # an Exit depends on every EXTERNAL input of its frame
-                for inm in fr.interior:
-                    for inp in self.by_name[inm]["inputs"]:
-                        b, ix = _base_name(inp)
-                        if ix >= 0 and b not in fr.interior \
-                                and b in self.needed:
-                            visit(b)
+                for b in fr.externals:
+                    if b in self.needed:
+                        visit(b)
             elif fr is not None:
                 pass  # interior nodes execute inside the frame's while
             elif node["op"] not in ("Placeholder", "PlaceholderV2",
@@ -514,6 +507,15 @@ class TFGraphModule(Module):
                 visit(_base_name(o)[0])
         finally:
             sys.setrecursionlimit(old)
+        # requesting a loop-INTERIOR node as an output cannot work (only
+        # Exit values exist after the fused while); fail at load, clearly
+        for o in outputs:
+            b = _base_name(o)[0]
+            fr = self._node_frame.get(b)
+            if fr is not None and self.by_name[b]["op"] != "Exit":
+                raise NotImplementedError(
+                    f"output {o!r} is inside while frame {fr.name!r}; "
+                    "only Exit values of a loop are addressable")
         self.order = order
         self._fold_constants()
 
@@ -606,7 +608,7 @@ class TFGraphModule(Module):
                 memo[nm] = bind[nm]
                 return bind[nm]
             if nm not in fr.interior:
-                return values[nm]
+                return values[nm]  # port/tag handling at the consumer
             node = self.by_name[nm]
             op = node["op"]
             if op in ("Merge",):  # bound above; a Merge not in bind is odd
@@ -623,12 +625,16 @@ class TFGraphModule(Module):
                 if ix < 0:
                     continue
                 v = ev(b)
-                args.append(v[ix] if isinstance(v, tuple) else v)
+                v = v[ix] if isinstance(v, tuple) else v
+                args.append(_tag_value(v))
             out = get_op(op)({**node["attrs"], "_node_name": nm}, *args)
             memo[nm] = out
             return out
 
-        return ev(_base_name(target)[0])
+        b, ix = _base_name(target)
+        v = ev(b)
+        v = v[ix] if isinstance(v, tuple) else v
+        return _tag_value(v)
 
     def _run_frame(self, fr, values) -> None:
         """Execute one while frame with lax.while_loop; store every
@@ -636,13 +642,17 @@ class TFGraphModule(Module):
         import jax.numpy as jnp
         from jax import lax
 
+        def outer_value(inp: str):
+            b, ix = _base_name(inp)
+            v = values[b]
+            v = v[ix] if isinstance(v, tuple) else v
+            return _tag_value(v)
+
         # initial carry: the Enter inputs (outer values), merge-ordered
-        carry0 = tuple(
-            jnp.asarray(values[_base_name(e["inputs"][0])[0]])
-            for e in fr.enters)
-        invariant_bind = {
-            inv["name"]: values[_base_name(inv["inputs"][0])[0]]
-            for inv in fr.invariants}
+        carry0 = tuple(jnp.asarray(outer_value(e["inputs"][0]))
+                       for e in fr.enters)
+        invariant_bind = {inv["name"]: outer_value(inv["inputs"][0])
+                          for inv in fr.invariants}
 
         def bindings(carry):
             bind = dict(invariant_bind)

@@ -47,10 +47,12 @@ class LoopFrame:
 
     __slots__ = ("name", "interior", "enters", "merges", "switches",
                  "exits", "next_iterations", "loop_cond", "invariants",
-                 "error")
+                 "error", "externals")
 
     def __init__(self, name: str):
         self.name = name
+        self.externals: set = set()     # node names OUTSIDE the frame
+        # that interior nodes read (the frame's data dependencies)
         self.error: Optional[str] = None  # set instead of raising so an
         # UNREACHABLE malformed frame never blocks loading; the executor
         # raises only if a pruned path actually needs this frame
@@ -98,6 +100,11 @@ def extract_frames(nodes: List[dict]) -> Dict[str, LoopFrame]:
                     stack.append(c["name"])
         for nm in frame.interior:
             node = by_name[nm]
+            for inp in node["inputs"]:
+                base = inp.split(":")[0]
+                if not base.startswith("^") and \
+                        base not in frame.interior:
+                    frame.externals.add(base)
             op = node["op"]
             if op == "Merge":
                 frame.merges.append(node)

@@ -314,7 +314,7 @@ class TestSequenceFile:
             got, pos = read_vint(b, 0)
             assert got == v and pos == len(b)
 
-    def test_rejects_compressed(self, tmp_path):
+    def test_rejects_block_compressed(self, tmp_path):
         from bigdl_tpu.dataset import seqfile as sq
         import struct
         p = str(tmp_path / "c.seq")
@@ -322,10 +322,10 @@ class TestSequenceFile:
             f.write(b"SEQ\x06")
             f.write(sq._hadoop_string(sq.TEXT))
             f.write(sq._hadoop_string(sq.TEXT))
-            f.write(bytes([1, 0]))  # compressed=True
+            f.write(bytes([0, 1]))  # blockCompressed=True
             f.write(struct.pack(">i", 0))
             f.write(b"\x00" * 16)
-        with pytest.raises(NotImplementedError, match="compressed"):
+        with pytest.raises(NotImplementedError, match="block"):
             list(sq.read_seqfile(p))
 
 
@@ -364,4 +364,33 @@ def test_seqfile_truncation_detected(tmp_path):
     raw = open(p, "rb").read()
     open(p, "wb").write(raw[:-20])  # cut mid-value
     with pytest.raises(IOError, match="truncated"):
+        list(sq.read_seqfile(p))
+
+
+def test_seqfile_record_compression_roundtrip(tmp_path):
+    from bigdl_tpu.dataset import seqfile as sq
+    p = str(tmp_path / "c.seq")
+    recs = [(f"k{i}".encode(), (f"payload-{i}-" * 20).encode())
+            for i in range(120)]
+    sq.write_seqfile(p, recs, compressed=True, sync_interval=50)
+    back = list(sq.read_seqfile(p))
+    assert back == recs
+    # compressed file is smaller than the raw payload total
+    import os as _os
+    assert _os.path.getsize(p) < sum(len(v) for _, v in recs)
+
+
+def test_seqfile_unknown_codec_rejected(tmp_path):
+    import struct
+    from bigdl_tpu.dataset import seqfile as sq
+    p = str(tmp_path / "x.seq")
+    with open(p, "wb") as f:
+        f.write(b"SEQ\x06")
+        f.write(sq._hadoop_string(sq.TEXT))
+        f.write(sq._hadoop_string(sq.TEXT))
+        f.write(bytes([1, 0]))
+        f.write(sq._hadoop_string("org.example.SnappyCodec"))
+        f.write(struct.pack(">i", 0))
+        f.write(b"\x00" * 16)
+    with pytest.raises(NotImplementedError, match="codec"):
         list(sq.read_seqfile(p))

@@ -728,3 +728,34 @@ def test_keras_functional_input_layers_order(tmp_path):
     # positional feed follows the DECLARED order: (in_b, in_a)
     out = core.forward((b, a))
     np.testing.assert_allclose(np.asarray(out), 10.0)
+
+
+def test_loop_interior_output_rejected(tmp_path):
+    """Regression: asking for a loop-interior node as an output fails at
+    LOAD with a clear message, not a KeyError at forward."""
+    from bigdl_tpu.utils import protowire as pw
+
+    def enter(name, inputs, frame):
+        body = pw.enc_str(1, name) + pw.enc_str(2, "Enter")
+        for i in inputs:
+            body += pw.enc_str(3, i)
+        body += pw.enc_bytes(5, pw.enc_str(1, "frame_name")
+                             + pw.enc_bytes(2, pw.enc_bytes(
+                                 2, frame.encode())))
+        return pw.enc_bytes(1, body)
+
+    g = (node("i0", "Placeholder")
+         + enter("i_ent", ["i0"], "f")
+         + node("i_mrg", "Merge", ["i_ent", "i_ni"])
+         + node("five", "Const", value=scalar_const(5.0))
+         + node("lt", "Less", ["i_mrg", "five"])
+         + node("lc", "LoopCond", ["lt"])
+         + node("i_sw", "Switch", ["i_mrg", "lc"])
+         + node("one", "Const", value=scalar_const(1.0))
+         + node("i_add", "Add", ["i_sw:1", "one"])
+         + node("i_ni", "NextIteration", ["i_add"])
+         + node("i_exit", "Exit", ["i_sw:0"]))
+    p = str(tmp_path / "g.pb")
+    open(p, "wb").write(g)
+    with pytest.raises(NotImplementedError, match="inside while frame"):
+        load_tf_graph(p, inputs=["i0"], outputs=["i_mrg"])
