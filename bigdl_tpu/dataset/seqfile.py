@@ -100,8 +100,10 @@ def read_seqfile(path: str) -> Iterator[Tuple[bytes, bytes]]:
         if magic != b"SEQ":
             raise IOError(f"{path} is not a SequenceFile")
         version = f.read(1)[0]
-        if version < 5:
-            raise NotImplementedError(f"SequenceFile version {version}")
+        if version < 6:
+            # v5 lacks the metadata section this parser expects
+            raise NotImplementedError(
+                f"SequenceFile version {version}; only v6 is supported")
         key_cls = _read_hadoop_string(f)
         val_cls = _read_hadoop_string(f)
         compressed = f.read(1)[0] != 0

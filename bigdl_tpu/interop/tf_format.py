@@ -616,7 +616,9 @@ class TFGraphModule(Module):
                     f"unbound Merge {nm} in while frame {fr.name}")
             if op in ("Switch", "LoopCond", "Identity", "NextIteration",
                       "Enter"):
-                out = ev(_base_name(node["inputs"][0])[0])
+                b0, ix0 = _base_name(node["inputs"][0])
+                out = ev(b0)
+                out = out[ix0] if isinstance(out, tuple) else out
                 memo[nm] = out
                 return out
             args = []

@@ -48,7 +48,7 @@ from bigdl_tpu.nn.criterion import (
     SmoothL1CriterionWithWeights, TimeDistributedMaskCriterion,
     TransformerCriterion, CategoricalCrossEntropy,
 )
-from bigdl_tpu.nn.graph import Graph, Input, Node
+from bigdl_tpu.nn.graph import Graph, DynamicGraph, Input, Node
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole,
     ConvLSTMPeephole3D, MultiRNNCell,

@@ -155,3 +155,21 @@ class Graph(Module):
 
         outs = [values[id(n)] for n in self.output_nodes]
         return (outs[0] if len(outs) == 1 else tuple(outs)), new_state
+
+
+class DynamicGraph(Graph):
+    """Name-parity alias of :class:`Graph` (reference ``DynamicGraph.scala``
+    + ``Scheduler.scala:104-145``).
+
+    The reference needs a separate dynamic graph executor because its
+    static graph precomputes a topological order that cannot express
+    data-dependent control flow; the ``Scheduler`` then interprets
+    Enter/Exit/Switch/Merge frames node-by-node with dead-token
+    propagation.  Under XLA that split disappears: data-dependent control
+    flow lives INSIDE compiled nodes as ``lax.cond`` / ``lax.while_loop``
+    (wrap them in :class:`~bigdl_tpu.nn.module.Lambda` or custom modules),
+    and imported TF control flow is compiled the same way by
+    ``interop.tf_format`` (Switch/Merge → select, loop frames →
+    ``lax.while_loop``).  This subclass exists so reference-named code
+    ports cleanly; behavior is identical to :class:`Graph`.
+    """

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tfgraph_util import attr_tensor, node, scalar_const, shape_const  # noqa: E501
+from tfgraph_util import (attr_tensor, enter, node, scalar_const,  # noqa: E501
+                          shape_const)
 from bigdl_tpu import nn
 from bigdl_tpu.interop import (load_bigdl_module, load_tf_graph,
                                save_bigdl_module, decode_bigdl_module)
@@ -630,14 +631,6 @@ class TestTFWhileLoopImport:
     def _while_graph(self, tmp_path):
         from bigdl_tpu.utils import protowire as pw
 
-        def enter(name, inputs, frame):
-            body = pw.enc_str(1, name) + pw.enc_str(2, "Enter")
-            for i in inputs:
-                body += pw.enc_str(3, i)
-            body += pw.enc_bytes(
-                5, pw.enc_str(1, "frame_name")
-                + pw.enc_bytes(2, pw.enc_bytes(2, frame.encode())))
-            return pw.enc_bytes(1, body)
 
         # while (i < 5): i += 1; acc *= 2
         g = (node("i0", "Placeholder")
@@ -735,14 +728,6 @@ def test_loop_interior_output_rejected(tmp_path):
     LOAD with a clear message, not a KeyError at forward."""
     from bigdl_tpu.utils import protowire as pw
 
-    def enter(name, inputs, frame):
-        body = pw.enc_str(1, name) + pw.enc_str(2, "Enter")
-        for i in inputs:
-            body += pw.enc_str(3, i)
-        body += pw.enc_bytes(5, pw.enc_str(1, "frame_name")
-                             + pw.enc_bytes(2, pw.enc_bytes(
-                                 2, frame.encode())))
-        return pw.enc_bytes(1, body)
 
     g = (node("i0", "Placeholder")
          + enter("i_ent", ["i0"], "f")

@@ -41,3 +41,13 @@ def shape_const(dims):
     t += pw.enc_bytes(2, shp)
     t += pw.enc_bytes(4, np.asarray(dims, np.int32).tobytes())
     return pw.enc_bytes(8, t)
+
+
+def enter(name, inputs, frame):
+    """Enter node with a frame_name attr (while-loop fixtures)."""
+    body = pw.enc_str(1, name) + pw.enc_str(2, "Enter")
+    for i in inputs:
+        body += pw.enc_str(3, i)
+    body += pw.enc_bytes(5, pw.enc_str(1, "frame_name")
+                         + pw.enc_bytes(2, pw.enc_bytes(2, frame.encode())))
+    return pw.enc_bytes(1, body)
