@@ -14,6 +14,7 @@ import pytest
 
 from tfgraph_util import (attr_tensor, enter, node, scalar_const,  # noqa: E501
                           shape_const)
+from bigdl_tpu.utils import protowire as pw
 from bigdl_tpu import nn
 from bigdl_tpu.interop import (load_bigdl_module, load_tf_graph,
                                save_bigdl_module, decode_bigdl_module)
@@ -140,8 +141,6 @@ class TestTFImport:
 
     def test_synthetic_graph_ops(self, tmp_path):
         """Exercise the ops layer + pruning via a hand-built GraphDef."""
-        from bigdl_tpu.utils import protowire as pw
-
 
 
         w = np.random.RandomState(0).randn(4, 3).astype(np.float32)
@@ -159,7 +158,6 @@ class TestTFImport:
                                    np.maximum(x @ w, 0.0), atol=1e-6)
 
     def test_missing_op_reports_clearly(self, tmp_path):
-        from bigdl_tpu.utils import protowire as pw
         g = (pw.enc_bytes(1, pw.enc_str(1, "x") + pw.enc_str(2, "Placeholder"))
              + pw.enc_bytes(1, pw.enc_str(1, "y")
                             + pw.enc_str(2, "SomeExoticOp")
@@ -217,7 +215,6 @@ class TestInteropReviewFixes:
                                    atol=1e-6)
 
     def test_port_suffixed_feed(self, tmp_path):
-        from bigdl_tpu.utils import protowire as pw
         g = (pw.enc_bytes(1, pw.enc_str(1, "x") + pw.enc_str(2, "Placeholder"))
              + pw.enc_bytes(1, pw.enc_str(1, "y") + pw.enc_str(2, "Neg")
                             + pw.enc_str(3, "x:0")))
@@ -629,9 +626,6 @@ def test_keras_functional_shared_layer_rejected():
 
 class TestTFWhileLoopImport:
     def _while_graph(self, tmp_path):
-        from bigdl_tpu.utils import protowire as pw
-
-
         # while (i < 5): i += 1; acc *= 2
         g = (node("i0", "Placeholder")
              + node("acc0", "Placeholder")
@@ -726,9 +720,6 @@ def test_keras_functional_input_layers_order(tmp_path):
 def test_loop_interior_output_rejected(tmp_path):
     """Regression: asking for a loop-interior node as an output fails at
     LOAD with a clear message, not a KeyError at forward."""
-    from bigdl_tpu.utils import protowire as pw
-
-
     g = (node("i0", "Placeholder")
          + enter("i_ent", ["i0"], "f")
          + node("i_mrg", "Merge", ["i_ent", "i_ni"])

@@ -53,3 +53,26 @@ def test_inception_recipe():
     out = _run("examples/inception/train.py", "--max-iteration", "4",
                "--synthetic-n", "32", "-b", "8", "--classes", "8")
     assert np.isfinite(_final_loss(out))
+
+
+def test_imagenet_recipe_smoke():
+    out = _run("examples/resnet/train_imagenet.py", "-e", "1",
+               "--synthetic-n", "48", "-b", "16", "--classes", "8",
+               "--warmup-epochs", "0", "--max-lr", "0.01")
+    assert np.isfinite(_final_loss(out))
+
+
+def test_textclassification_recipe():
+    out = _run("examples/textclassification/train.py", "-e", "4")
+    for line in out.splitlines():
+        if line.startswith("final:"):
+            acc = float(line.split("train_acc=")[1])
+            assert acc > 0.9, line
+            return
+    raise AssertionError(out)
+
+
+def test_udfpredictor_service():
+    out = _run("examples/udfpredictor/serve.py", "--requests", "16",
+               "--threads", "4")
+    assert "served 16 requests" in out
