@@ -126,7 +126,12 @@ def _decode_attr(data: bytes, storages) -> Tuple[int, Any]:
         return dtype, None
     if 16 in m:  # DataFormat enum: 0 NCHW, 1 NHWC
         return dtype, "NCHW" if pw.ints(m, 16)[0] == 0 else "NHWC"
-    return dtype, None
+    # oneof absent (hand-written/partial file; genuine writers always set
+    # it): fall back to the dataType's zero value so downstream int()/
+    # float() coercions get a diagnosable default rather than None
+    zero = {DT_INT32: 0, DT_INT64: 0, DT_FLOAT: 0.0, DT_DOUBLE: 0.0,
+            DT_STRING: "", DT_BOOL: False}
+    return dtype, zero.get(dtype)
 
 
 def decode_bigdl_module(data: bytes,
@@ -149,6 +154,11 @@ def decode_bigdl_module(data: bytes,
         "attrs": attrs,
         "has_parameters": bool(pw.ints(m, 15)[0]) if 15 in m else False,
         "parameters": [_decode_tensor(t, storages) for t in m.get(16, [])],
+        # deprecated pre-hasParameters layout (BigDLModule weight=3/bias=4);
+        # decoded so the loader can refuse loudly instead of silently
+        # leaving random init weights in place
+        "legacy_weight": _decode_tensor(m[3][0], storages) if 3 in m else None,
+        "legacy_bias": _decode_tensor(m[4][0], storages) if 4 in m else None,
         "pre_modules": [pw.as_str(v) for v in m.get(5, [])],
         "next_modules": [pw.as_str(v) for v in m.get(6, [])],
     }
@@ -273,9 +283,18 @@ def _bigdl_weights_to_params(module: Module, node: dict, params, state):
         return
     ps = [p for p in node["parameters"] if p is not None]
     if not ps:
-        # legacy weight/bias fields unsupported (hasParameters is set by
-        # every modern writer incl. ours)
-        return
+        lw, lb = node.get("legacy_weight"), node.get("legacy_bias")
+        if lw is not None:
+            # map the deprecated layout (weight=3/bias=4) through the same
+            # per-type paths instead of dropping it on the floor
+            ps = [lw] + ([lb] if lb is not None else [])
+        elif lb is not None:
+            raise ValueError(
+                f"module {node['name']!r} ({t}): legacy bias (field 4) "
+                "present but its weight (field 3) failed to decode — "
+                "refusing to load a partially-decoded legacy checkpoint")
+        else:
+            return
     if t == "SpatialConvolution":
         w = ps[0]
         if w.ndim == 5:  # (g, out/g, in/g, kh, kw) -> (out, in/g, kh, kw)

@@ -7,9 +7,9 @@ maps a Keras-1.2.2 ``model.to_json()`` document onto BigDL layers;
 TPU redesign: the JSON maps onto the deferred ``bigdl_tpu.keras``
 wrappers (which already reproduce the Keras-1.2 layer surface + shape
 inference), so the converter is a thin config translation.  HDF5 weight
-loading is gated on ``h5py`` being importable (not a baked dependency);
+loading uses ``h5py`` (``load_keras_hdf5_weights``);
 ``set_keras_weights`` applies a plain list of arrays in Keras order for
-environments without it.
+callers who extracted weights themselves.
 """
 
 from __future__ import annotations
@@ -201,21 +201,45 @@ def set_keras_weights(model: "K.Sequential",
     """Install a flat Keras-order weight list (each layer's
     ``get_weights()`` concatenated) into the built core module
     (reference ``WeightLoader``; Keras Dense stores W as (in, out) —
-    transposed into our (out, in))."""
+    transposed into our (out, in)).
+
+    The walk pairs each *module* with its params/state subtree via
+    ``spec_children()`` so stateful layers can consume the right number
+    of arrays: Keras-1.2 BatchNormalization saves FOUR (gamma, beta,
+    running_mean, running_std) — and its ``running_std`` attribute
+    actually holds the *variance* (the reference's ``setRunningStd``
+    writes it straight into ``runningVar``,
+    ``PythonBigDLKeras.scala:151-154``), so it is installed as
+    ``running_var`` unchanged."""
     import jax
     import jax.numpy as jnp
 
     core = model.core_module()
     core._ensure_init()
     params = jax.tree_util.tree_map(np.asarray, core._params)
+    states = jax.tree_util.tree_map(np.asarray, core._state)
     w_ix = 0
 
-    def fill(p):
+    def take():
         nonlocal w_ix
-        # dict of leaves for one layer: weight (+bias)
+        w = np.asarray(weights[w_ix])
+        w_ix += 1
+        return w
+
+    def fill(module, p, s):
+        nonlocal w_ix
+        if isinstance(s, dict) and "running_mean" in s:
+            # BatchNormalization: gamma, beta, mean, std(=var; see docstring)
+            if isinstance(p, dict) and "weight" in p:
+                p["weight"] = take().reshape(p["weight"].shape)
+                p["bias"] = take().reshape(p["bias"].shape)
+            s["running_mean"] = take().reshape(s["running_mean"].shape)
+            s["running_var"] = take().reshape(s["running_var"].shape)
+            return
+        if not isinstance(p, dict):
+            return
         if "weight" in p:
-            w = np.asarray(weights[w_ix])
-            w_ix += 1
+            w = take()
             tgt = p["weight"]
             if w.ndim == 2 and w.shape == tgt.shape[::-1]:
                 w = w.T               # Keras Dense (in,out) -> (out,in)
@@ -225,21 +249,29 @@ def set_keras_weights(model: "K.Sequential",
                 w = np.transpose(w, (3, 2, 0, 1))
             p["weight"] = w.reshape(tgt.shape)
         if "bias" in p:
-            p["bias"] = np.asarray(weights[w_ix]).reshape(p["bias"].shape)
-            w_ix += 1
+            p["bias"] = take().reshape(p["bias"].shape)
 
-    def walk(p):
-        if isinstance(p, dict) and ("weight" in p or "bias" in p):
-            fill(p)
+    def walk(module, p, s):
+        children = module.spec_children()
+        if children is None:
+            fill(module, p, s)
             return
-        if isinstance(p, dict):
-            for k in sorted(p.keys(), key=lambda s: (len(s), s)):
-                walk(p[k])
+        if isinstance(children, dict):
+            keys = list(children.keys())
+            if all(k.isdigit() for k in keys):
+                keys.sort(key=int)
+            for k in keys:
+                walk(children[k],
+                     p.get(k, {}) if isinstance(p, dict) else {},
+                     s.get(k, {}) if isinstance(s, dict) else {})
+            return
+        walk(children, p, s)  # single-child delegating wrapper
 
-    walk(params)
+    walk(core, params, states)
     if w_ix != len(weights):
         raise ValueError(f"consumed {w_ix} of {len(weights)} weight arrays")
     core._params = jax.tree_util.tree_map(jnp.asarray, params)
+    core._state = jax.tree_util.tree_map(jnp.asarray, states)
     model._params = core._params
     model._mstate = core._state
 

@@ -43,7 +43,12 @@ _LOSSES = {
     "mae": nn.AbsCriterion, "mean_absolute_error": nn.AbsCriterion,
     "binary_crossentropy": nn.BCECriterion,
     "hinge": nn.MarginCriterion,
-    "kld": nn.DistKLDivCriterion,
+    # Keras kld takes PROBABILITY predictions (reference
+    # pyspark/bigdl/keras/optimization.py pairs it with
+    # KullbackLeiblerDivergenceCriterion); DistKLDivCriterion would
+    # require log-probability inputs.
+    "kld": nn.KullbackLeiblerDivergenceCriterion,
+    "kullback_leibler_divergence": nn.KullbackLeiblerDivergenceCriterion,
 }
 
 _METRICS = {

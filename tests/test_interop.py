@@ -356,6 +356,81 @@ class TestKerasJSON:
         with pytest.raises(NotImplementedError, match="Lambda"):
             load_keras_json(doc)
 
+    def _bn_json(self):
+        import json
+        return json.dumps({
+            "class_name": "Sequential",
+            "config": [
+                {"class_name": "Dense",
+                 "config": {"output_dim": 8, "activation": "linear",
+                            "batch_input_shape": [None, 4]}},
+                {"class_name": "BatchNormalization",
+                 "config": {"epsilon": 1e-3}},
+                {"class_name": "Dense", "config": {"output_dim": 3}},
+            ]})
+
+    def _bn_weights(self, rng):
+        # Keras-1.2 save order; BN = gamma, beta, mean, "std" (= variance,
+        # see set_keras_weights docstring)
+        return [rng.rand(4, 8).astype(np.float32),
+                rng.rand(8).astype(np.float32),
+                rng.rand(8).astype(np.float32) + 0.5,     # gamma
+                rng.rand(8).astype(np.float32),           # beta
+                rng.rand(8).astype(np.float32),           # running mean
+                rng.rand(8).astype(np.float32) + 0.5,     # running var
+                rng.rand(8, 3).astype(np.float32),
+                rng.rand(3).astype(np.float32)]
+
+    def _bn_reference(self, ws, x):
+        h = x @ ws[0] + ws[1]
+        hn = ws[2] * (h - ws[4]) / np.sqrt(ws[5] + 1e-3) + ws[3]
+        return hn @ ws[6] + ws[7]
+
+    def test_batchnorm_consumes_four_arrays(self):
+        # ADVICE r2: BN layers must consume gamma/beta/mean/var, not shift
+        # the array stream by two
+        from bigdl_tpu.interop import load_keras_json, set_keras_weights
+        m = load_keras_json(self._bn_json())
+        rng = np.random.RandomState(1)
+        ws = self._bn_weights(rng)
+        set_keras_weights(m, ws)
+        x = rng.rand(2, 4).astype(np.float32)
+        core = m.core_module()
+        core.training = False
+        out = np.asarray(core.forward(x))
+        np.testing.assert_allclose(out, self._bn_reference(ws, x),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_hdf5_weight_loader(self, tmp_path):
+        # reference pyspark/bigdl/keras/converter.py:32 WeightLoader
+        import h5py
+        from bigdl_tpu.interop import load_keras_json, \
+            load_keras_hdf5_weights
+        rng = np.random.RandomState(2)
+        ws = self._bn_weights(rng)
+        path = str(tmp_path / "w.h5")
+        layer_ws = [("dense_1", ws[0:2]), ("batchnormalization_1", ws[2:6]),
+                    ("dense_2", ws[6:8])]
+        with h5py.File(path, "w") as f:
+            grp = f.create_group("model_weights")
+            grp.attrs["layer_names"] = [n.encode()
+                                        for n, _ in layer_ws]
+            for name, arrays in layer_ws:
+                g = grp.create_group(name)
+                wn = [f"{name}_{i}".encode()
+                      for i in range(len(arrays))]
+                g.attrs["weight_names"] = wn
+                for n, a in zip(wn, arrays):
+                    g.create_dataset(n.decode(), data=a)
+        m = load_keras_json(self._bn_json())
+        load_keras_hdf5_weights(m, path)
+        x = rng.rand(2, 4).astype(np.float32)
+        core = m.core_module()
+        core.training = False
+        out = np.asarray(core.forward(x))
+        np.testing.assert_allclose(out, self._bn_reference(ws, x),
+                                   rtol=2e-4, atol=1e-5)
+
 
 class TestReviewFixesE:
     def test_multi_output_op_inside_switch_branch(self, tmp_path):

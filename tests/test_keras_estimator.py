@@ -78,6 +78,15 @@ class TestKerasFit:
         preds = m.predict_classes(x[:64])
         assert (preds == y[:64]).mean() > 0.85
 
+    def test_kld_maps_to_probability_criterion(self):
+        # ADVICE r2: Keras "kld" takes probability inputs ->
+        # KullbackLeiblerDivergenceCriterion, NOT DistKLDivCriterion
+        # (log-prob inputs)
+        from bigdl_tpu.keras.topology import _LOSSES
+        assert _LOSSES["kld"] is nn.KullbackLeiblerDivergenceCriterion
+        assert _LOSSES["kullback_leibler_divergence"] \
+            is nn.KullbackLeiblerDivergenceCriterion
+
     def test_fit_with_validation(self):
         x, y = _blobs(128)
         m = Sequential([Dense(3, activation="softmax",
