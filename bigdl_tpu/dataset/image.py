@@ -73,7 +73,7 @@ class HFlip(_SampleMap):
 
     def __init__(self, threshold: float = 0.5, seed: int = 0):
         self.threshold = threshold
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def _map(self, s):
         if self._rng.random() < self.threshold:
@@ -88,7 +88,7 @@ class RandomCropper(_SampleMap):
 
     def __init__(self, crop_h: int, crop_w: int, pad: int = 0, seed: int = 0):
         self.crop_h, self.crop_w, self.pad = crop_h, crop_w, pad
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def _map(self, s):
         f = s.feature
@@ -133,7 +133,7 @@ class ColorJitter(_SampleMap):
     def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
                  saturation: float = 0.4, seed: int = 0):
         self.b, self.c, self.s = brightness, contrast, saturation
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def _map(self, s):
         return Sample(color_jitter(s.feature.astype(np.float32), self._rng,
@@ -146,7 +146,7 @@ class Lighting(_SampleMap):
 
     def __init__(self, alphastd: float = 0.1, seed: int = 0):
         self.alphastd = alphastd
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def _map(self, s):
         return Sample(s.feature + lighting_delta(self._rng, self.alphastd),

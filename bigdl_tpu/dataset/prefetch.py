@@ -16,6 +16,7 @@ batch ``i``.  Composes as a normal Transformer:
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -25,6 +26,7 @@ import numpy as np
 
 from bigdl_tpu.dataset.sample import Sample, MiniBatch
 from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.imgops import sample_key
 
 
 def _stack(samples) -> MiniBatch:
@@ -52,6 +54,11 @@ class MTSampleToMiniBatch(Transformer):
         self.workers = workers
         self.prefetch = max(1, prefetch)
         self.drop_remainder = drop_remainder
+        # per-instance pass counter folded into the sample key: calling
+        # the SAME transformer once per epoch over a fixed-order dataset
+        # must still draw fresh augmentation each epoch (run-to-run
+        # deterministic, pass-to-pass varying)
+        self._passes = itertools.count()
 
     def __call__(self, it: Iterator[Sample]) -> Iterator[MiniBatch]:
         out_q: queue.Queue = queue.Queue(maxsize=self.prefetch)
@@ -70,8 +77,20 @@ class MTSampleToMiniBatch(Transformer):
                     continue
             return False
 
+        pass_ix = next(self._passes)
+
+        def keyed_transform(ix_sample):
+            # bracket the transform in the stream position so ThreadRng
+            # draws are a pure function of (seed, pass, sample index) —
+            # run-to-run deterministic no matter which worker thread
+            # executes it
+            ix, sample = ix_sample
+            with sample_key((pass_ix << 40) | ix):
+                return self.transform(sample)
+
         def producer():
             pool = ThreadPoolExecutor(max_workers=self.workers)
+            stream_ix = 0
             try:
                 buf = []
                 # map the per-sample transform with bounded lookahead:
@@ -87,7 +106,10 @@ class MTSampleToMiniBatch(Transformer):
                     if not chunk:
                         break
                     if self.transform is not None:
-                        chunk = list(pool.map(self.transform, chunk))
+                        chunk = list(pool.map(
+                            keyed_transform,
+                            enumerate(chunk, start=stream_ix)))
+                    stream_ix += len(chunk)
                     buf.extend(chunk)
                     while len(buf) >= self.batch_size:
                         if not put_or_stop(_stack(buf[:self.batch_size])):
@@ -101,10 +123,12 @@ class MTSampleToMiniBatch(Transformer):
                 put_or_stop(e)
             finally:
                 pool.shutdown(wait=False)
-                try:
-                    out_q.put_nowait(_END)
-                except queue.Full:
-                    pass  # consumer is gone; it drains on exit anyway
+                # _END must be DELIVERED, not best-effort: a put_nowait
+                # here can hit a momentarily-full queue while the consumer
+                # is alive and leave it blocked on get() forever.  The
+                # stop-aware bounded put gives up only once the consumer
+                # has exited (stop set in its finally).
+                put_or_stop(_END)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

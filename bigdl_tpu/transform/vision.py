@@ -136,7 +136,7 @@ class Brightness(FeatureTransformer):
 
     def __init__(self, delta_low: float, delta_high: float, seed: int = 0):
         self.low, self.high = delta_low, delta_high
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         f.image = f.image + self._rng.uniform(self.low, self.high)
@@ -148,7 +148,7 @@ class Contrast(FeatureTransformer):
 
     def __init__(self, delta_low: float, delta_high: float, seed: int = 0):
         self.low, self.high = delta_low, delta_high
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         f.image = f.image * self._rng.uniform(self.low, self.high)
@@ -160,7 +160,7 @@ class Saturation(FeatureTransformer):
 
     def __init__(self, delta_low: float, delta_high: float, seed: int = 0):
         self.low, self.high = delta_low, delta_high
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         hsv = _rgb_to_hsv(np.clip(f.image, 0, 255))
@@ -177,7 +177,7 @@ class Hue(FeatureTransformer):
     def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
                  seed: int = 0):
         self.low, self.high = delta_low, delta_high
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         hsv = _rgb_to_hsv(np.clip(f.image, 0, 255))
@@ -257,7 +257,7 @@ class RandomAspectScale(AspectScale):
                  seed: int = 0):
         super().__init__(scales[0], max_size)
         self.scales = list(scales)
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         # no shared-state write (``self.min_size``) — transforms run on
@@ -292,7 +292,7 @@ class RandomCrop(FeatureTransformer):
 
     def __init__(self, crop_h: int, crop_w: int, pad: int = 0, seed: int = 0):
         self.ch, self.cw, self.pad = crop_h, crop_w, pad
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         img = f.image
@@ -334,7 +334,7 @@ class Expand(FeatureTransformer):
                  max_expand_ratio: float = 4.0, seed: int = 0):
         self.means = np.asarray(means, np.float32)
         self.max_ratio = max_expand_ratio
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         img = f.image
@@ -373,7 +373,7 @@ class HFlip(FeatureTransformer):
 
     def __init__(self, threshold: float = 0.5, seed: int = 0):
         self.threshold = threshold
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         if self._rng.random() < self.threshold:
@@ -392,7 +392,7 @@ class RandomAlterAspect(FeatureTransformer):
         self.min_area, self.max_area = min_area_ratio, max_area_ratio
         self.min_aspect = min_aspect_ratio
         self.target = target_size
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         img = f.image
@@ -421,7 +421,7 @@ class ColorJitter(FeatureTransformer):
     def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
                  saturation: float = 0.4, seed: int = 0):
         self.b, self.c, self.s = brightness, contrast, saturation
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         f.image = color_jitter(f.image, self._rng, self.b, self.c, self.s)
@@ -433,7 +433,7 @@ class Lighting(FeatureTransformer):
 
     def __init__(self, alphastd: float = 0.1, seed: int = 0):
         self.alphastd = alphastd
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         f.image = f.image + lighting_delta(self._rng, self.alphastd)
@@ -448,7 +448,7 @@ class RandomTransformer(FeatureTransformer):
                  seed: int = 0):
         self.inner = inner
         self.prob = prob
-        self._rng = ThreadRng(seed)
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
 
     def transform(self, f):
         return self.inner(f) if self._rng.random() < self.prob else f

@@ -10,14 +10,38 @@ constants and fixes cannot drift between them.
 batch assembler (``dataset/prefetch.py``): numpy ``Generator`` is not
 thread-safe, so each worker thread gets its own child generator spawned
 deterministically from the seed.
+
+Per-thread streams alone are NOT run-to-run deterministic under the
+multi-worker assembler: which sample lands on which thread is
+scheduler-dependent.  So the assembler brackets each transform call in
+:func:`sample_key`, and ``ThreadRng`` then derives every draw from
+``(seed, instance_salt, sample_index)`` — a pure function of the data
+stream, independent of thread scheduling (same counter-based-RNG idea
+as ``jax.random.fold_in``).
 """
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
+import zlib
 
 import numpy as np
+
+_sample_key = threading.local()
+
+
+@contextlib.contextmanager
+def sample_key(key: int):
+    """Pin the active per-sample RNG key for the current thread (set by
+    the batch assembler around each per-sample transform call)."""
+    prev = getattr(_sample_key, "key", None)
+    _sample_key.key = key
+    try:
+        yield
+    finally:
+        _sample_key.key = prev
 
 # eigen decomposition of ImageNet RGB covariance (AlexNet lighting noise;
 # reference ``Lighting.scala`` constants)
@@ -29,15 +53,34 @@ LIGHTING_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
 
 class ThreadRng:
     """Per-thread numpy Generators, deterministically derived from one
-    seed.  Same interface subset as ``np.random.Generator``."""
+    seed.  Same interface subset as ``np.random.Generator``.
 
-    def __init__(self, seed: int = 0):
+    Under an active :func:`sample_key`, draws come from a generator
+    seeded by ``(seed, salt, key)`` instead — scheduling-independent AND
+    stable across construction order/processes.  ``salt`` (a string,
+    conventionally the owning transform's class name) keeps two
+    transforms built with the same seed (e.g. ``RandomCropper`` +
+    ``HFlip``, both default seed 0) from replaying identical streams per
+    sample; two instances of the SAME class in one pipeline should be
+    given distinct seeds."""
+
+    def __init__(self, seed: int = 0, salt: str = ""):
+        self._seed = seed
+        self._salt = zlib.crc32(salt.encode())
         self._seed_seq = np.random.SeedSequence(seed)
         self._counter = itertools.count()
         self._lock = threading.Lock()
         self._local = threading.local()
 
     def _gen(self) -> np.random.Generator:
+        key = getattr(_sample_key, "key", None)
+        if key is not None:
+            cached = getattr(self._local, "keyed", None)
+            if cached is None or cached[0] != key:
+                g = np.random.default_rng(
+                    np.random.SeedSequence((self._seed, self._salt, key)))
+                self._local.keyed = (key, g)
+            return self._local.keyed[1]
         g = getattr(self._local, "gen", None)
         if g is None:
             with self._lock:

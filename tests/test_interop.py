@@ -403,7 +403,7 @@ class TestKerasJSON:
 
     def test_hdf5_weight_loader(self, tmp_path):
         # reference pyspark/bigdl/keras/converter.py:32 WeightLoader
-        import h5py
+        h5py = pytest.importorskip("h5py")
         from bigdl_tpu.interop import load_keras_json, \
             load_keras_hdf5_weights
         rng = np.random.RandomState(2)
