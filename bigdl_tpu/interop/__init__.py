@@ -15,4 +15,8 @@ from bigdl_tpu.interop.keras_format import (
     load_keras_json, set_keras_weights, load_keras_hdf5_weights,
 )
 from bigdl_tpu.interop.tf_export import save_tf_graph
+from bigdl_tpu.interop.caffe_export import save_caffe
+from bigdl_tpu.interop.torch_export import (
+    save_torch_module, load_torch_module,
+)
 from bigdl_tpu.interop.session import TFSession

@@ -123,6 +123,8 @@ def _decode_attr(data: bytes, storages) -> Tuple[int, Any]:
         if adt == DT_TENSOR:
             return dtype, [_decode_tensor(v, storages)
                            for v in am.get(10, [])]
+        if adt == DT_STRING:
+            return dtype, [pw.as_str(v) for v in am.get(7, [])]
         return dtype, None
     if 16 in m:  # DataFormat enum: 0 NCHW, 1 NHWC
         return dtype, "NCHW" if pw.ints(m, 16)[0] == 0 else "NHWC"
@@ -161,6 +163,8 @@ def decode_bigdl_module(data: bytes,
         "legacy_bias": _decode_tensor(m[4][0], storages) if 4 in m else None,
         "pre_modules": [pw.as_str(v) for v in m.get(5, [])],
         "next_modules": [pw.as_str(v) for v in m.get(6, [])],
+        # unique instance id (bigdl.proto field 12) — shared-module marker
+        "id": pw.ints(m, 12)[0] if 12 in m else None,
     }
 
 
@@ -177,6 +181,50 @@ def _build(node: dict) -> Module:
     name = node["name"] or None
 
     def ctor() -> Module:
+        if t in ("StaticGraph", "Graph", "DynamicGraph"):
+            # reference GraphSerializable (Graph.scala:563): subModules
+            # with preModules edges; inputNames/outputNames attrs.  A
+            # repeated submodule NAME = shared instance (weight tying).
+            from bigdl_tpu.nn.graph import Graph as GGraph, Input as GInput
+            in_names = list(a.get("inputNames", []))
+            out_names = list(a.get("outputNames", []))
+            # shared instances are tied by the proto `id` field; a
+            # repeated NAME (legacy writers without ids) ties too
+            built_by_id: Dict[int, Module] = {}
+            built_by_name: Dict[str, Module] = {}
+            occurrence: Dict[str, Any] = {}
+            inputs_by_name: Dict[str, Any] = {}
+            for sub in node["sub_modules"]:
+                st = sub["module_type"].rsplit(".", 1)[-1]
+                nm = sub["name"]
+                if st == "Input":
+                    ph = GInput()
+                    occurrence[nm] = ph
+                    inputs_by_name[nm] = ph
+                    continue
+                iid = sub.get("id")
+                mod = (built_by_id.get(iid) if iid is not None
+                       else built_by_name.get(nm))
+                if mod is None:
+                    mod = _build(sub)
+                    built_by_name[nm] = mod
+                    if iid is not None:
+                        built_by_id[iid] = mod
+                pres = list(sub["pre_modules"])
+                if not pres:
+                    if nm not in in_names:
+                        raise ValueError(
+                            f"graph node {nm!r} has no preModules and is "
+                            "not an input")
+                    ph = inputs_by_name.setdefault(nm, GInput())
+                    pres_nodes = [ph]
+                else:
+                    pres_nodes = [occurrence[p] for p in pres]
+                occurrence[nm] = mod(pres_nodes if len(pres_nodes) > 1
+                                     else pres_nodes[0])
+            inputs = [inputs_by_name[n] for n in in_names]
+            outputs = [occurrence[n] for n in out_names]
+            return GGraph(inputs, outputs, name=name)
         if t == "Sequential":
             m = nn.Sequential(name=name)
             for c in _build_children(node):
@@ -236,6 +284,8 @@ def _build(node: dict) -> Module:
                 name=name)
         if t == "Dropout":
             return nn.Dropout(float(a.get("initP", 0.5)), name=name)
+        if t == "Scale":
+            return nn.Scale(tuple(int(v) for v in a["size"]), name=name)
         if t == "Reshape":
             return nn.Reshape(tuple(int(v) for v in a["size"]), name=name)
         if t == "View":
@@ -275,6 +325,19 @@ def _bigdl_weights_to_params(module: Module, node: dict, params, state):
     conv weights are stored (nGroup, out/g, in/g, kH, kW) by the
     reference (``VariableFormat.GP_OUT_IN_KW_KH``) vs our OIHW."""
     t = node["module_type"].rsplit(".", 1)[-1]
+    from bigdl_tpu.nn.graph import Graph as _GGraph
+    if isinstance(module, _GGraph):
+        # graph params are keyed by first-occurrence order index; each
+        # built module stashed its decoded node (weights live on the
+        # first occurrence of a shared name)
+        for i, gnode in enumerate(module._order):
+            sub = getattr(gnode.module, "_bigdl_node", None)
+            if sub is not None:
+                key = module._param_keys[i]
+                _bigdl_weights_to_params(gnode.module, sub,
+                                         params.get(key, {}),
+                                         state.get(key, {}))
+        return
     if t in ("Sequential", "Concat", "ConcatTable"):
         for i, sub in enumerate(node["sub_modules"]):
             _bigdl_weights_to_params(module.modules[i], sub,
@@ -302,6 +365,12 @@ def _bigdl_weights_to_params(module: Module, node: dict, params, state):
         params["weight"] = w
         if len(ps) > 1 and "bias" in params:
             params["bias"] = ps[1]
+    elif t == "Scale":
+        params["mul"]["weight"] = ps[0].reshape(
+            params["mul"]["weight"].shape)
+        if len(ps) > 1:
+            params["add"]["bias"] = ps[1].reshape(
+                params["add"]["bias"].shape)
     elif t in ("Linear", "TemporalConvolution", "LookupTable"):
         params["weight"] = ps[0]
         if len(ps) > 1 and "bias" in params:
@@ -397,6 +466,12 @@ def _enc_attr_tensor(arr, sid) -> bytes:
                                                                       sid))
 
 
+def _enc_attr_str_array(vs) -> bytes:
+    av = (pw.enc_varint(1, len(vs)) + pw.enc_varint(2, DT_STRING)
+          + b"".join(pw.enc_str(7, str(v)) for v in vs))
+    return pw.enc_varint(1, DT_ARRAY_VALUE) + pw.enc_bytes(15, av)
+
+
 class _Exporter:
     def __init__(self):
         self.next_id = 1
@@ -458,6 +533,8 @@ class _Exporter:
                     "k": _enc_attr_double(m.k)}
         if t == "Dropout":
             return {"initP": _enc_attr_double(m.p)}
+        if t == "Scale":
+            return {"size": _enc_attr_int_array(m.cmul.size)}
         if t in ("Reshape", "View"):  # View subclasses Reshape
             return {"size": _enc_attr_int_array(m.size),
                     "batchMode": _enc_attr_int(0)}
@@ -475,11 +552,23 @@ class _Exporter:
                     "strideW": _enc_attr_int(m.stride_w)}
         return {}
 
-    def encode(self, m: Module, params, state) -> bytes:
+    def encode(self, m: Module, params, state, pre=(), nxt=(),
+               name: Optional[str] = None, with_params: bool = True) -> bytes:
+        from bigdl_tpu.nn.graph import Graph as _Graph
+        if isinstance(m, _Graph):
+            return self.encode_graph(m, params, state, pre, nxt)
         t = type(m).__name__
-        body = pw.enc_str(1, m.name or t)
+        body = pw.enc_str(1, name or m.name or t)
+        for p in pre:
+            body += pw.enc_str(5, p)
+        for nx in nxt:
+            body += pw.enc_str(6, nx)
         body += pw.enc_str(7, _NN + t)
         body += pw.enc_str(9, "0.2.0")
+        if not with_params:
+            # shared-instance later occurrence: structure only, weights
+            # ride the first occurrence (reference dedups via tensor ids)
+            params, state = {}, {}
 
         if t in ("Sequential", "Concat", "ConcatTable"):
             for i, child in enumerate(m.modules):
@@ -504,6 +593,82 @@ class _Exporter:
                     body += pw.enc_bytes(8, entry)
         return body
 
+    def encode_graph(self, g, params, state, pre=(), nxt=()) -> bytes:
+        """Serialize :class:`nn.Graph` as the reference ``StaticGraph``
+        scheme (``Graph.scala:563`` GraphSerializable): subModules carry
+        ``preModules``/``nextModules`` edges, attrs carry
+        ``inputNames``/``outputNames``.  The reference's redundant
+        per-node ``<name>_edges`` NameAttrList map is not written —
+        ``preModules`` order carries the same information and the loader
+        here reads that.  Shared module instances: every graph
+        OCCURRENCE gets its own (unique) submodule name so edges stay
+        unambiguous; occurrences of one instance share the ``id`` field
+        (bigdl.proto field 12, 'used for shared modules') and only the
+        first carries the weights."""
+        body = pw.enc_str(1, g.name or "Graph")
+        for p in pre:
+            body += pw.enc_str(5, p)
+        for nx in nxt:
+            body += pw.enc_str(6, nx)
+        body += pw.enc_str(7, _NN + "StaticGraph")
+        body += pw.enc_str(9, "0.2.0")
+
+        # per-OCCURRENCE unique name; per-INSTANCE shared id
+        node_names: Dict[int, str] = {}      # id(node) -> name
+        inst_ids: Dict[int, int] = {}        # id(module) -> instance id
+        used: Dict[str, int] = {}
+        for node in g._order:
+            mod = node.module
+            base = mod.name or type(mod).__name__
+            n = used.get(base, 0)
+            used[base] = n + 1
+            node_names[id(node)] = base if n == 0 else f"{base}@{n}"
+            inst_ids.setdefault(id(mod), len(inst_ids) + 1)
+        in_names = []
+        for i, inp in enumerate(g.input_nodes):
+            nm = f"graph_input_{i}"
+            node_names[id(inp)] = nm
+            in_names.append(nm)
+
+        def node_name(n):
+            return node_names[id(n)]
+
+        # consumers per node (nextModules)
+        consumers: Dict[int, List[str]] = {}
+        for node in g._order:
+            for p in node.inputs:
+                consumers.setdefault(id(p), []).append(node_name(node))
+
+        # Input placeholder submodules
+        for i, inp in enumerate(g.input_nodes):
+            sub = (pw.enc_str(1, in_names[i])
+                   + b"".join(pw.enc_str(6, c)
+                              for c in consumers.get(id(inp), []))
+                   + pw.enc_str(7, _NN + "Input")
+                   + pw.enc_str(9, "0.2.0"))
+            body += pw.enc_bytes(2, sub)
+
+        emitted: set = set()
+        for node, key in zip(g._order, g._param_keys):
+            mod = node.module
+            first = id(mod) not in emitted
+            emitted.add(id(mod))
+            sub = self.encode(
+                mod, params.get(key, {}), state.get(key, {}),
+                pre=[node_name(p) for p in node.inputs],
+                nxt=consumers.get(id(node), []),
+                name=node_name(node), with_params=first)
+            sub += pw.enc_varint(12, inst_ids[id(mod)])
+            body += pw.enc_bytes(2, sub)
+
+        for akey, aval in (("inputNames", in_names),
+                           ("outputNames",
+                            [node_name(n) for n in g.output_nodes])):
+            entry = pw.enc_str(1, akey) + pw.enc_bytes(
+                2, _enc_attr_str_array(aval))
+            body += pw.enc_bytes(8, entry)
+        return body
+
     @staticmethod
     def module_tensors(m: Module, params) -> List[np.ndarray]:
         t = type(m).__name__
@@ -516,13 +681,19 @@ class _Exporter:
             if "bias" in params:
                 out.append(np.asarray(params["bias"]))
             return out
+        if t == "Scale":
+            # params nest under the CMul/CAdd children; reference Scale
+            # parameters() order is (weight, bias)
+            return [np.asarray(params["mul"]["weight"]),
+                    np.asarray(params["add"]["bias"])]
         out = []
         if "weight" in params:
             out.append(np.asarray(params["weight"]))
         if "bias" in params:
             out.append(np.asarray(params["bias"]))
         if not out:  # fallback: sorted order, mirrors the generic reader
-            out = [np.asarray(params[k]) for k in sorted(params.keys())]
+            out = [np.asarray(params[k]) for k in sorted(params.keys())
+                   if not isinstance(params[k], dict)]
         return out
 
 

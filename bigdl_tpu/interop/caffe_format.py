@@ -117,6 +117,11 @@ def _conv_module(name, cp, blobs):
     dil = int(_pick(cp, "dilation", 1))
     bias = bool(_pick(cp, "bias_term", True))
     w = blobs[0]
+    if w.ndim < 4:
+        # reference CaffePersister writes only num/channels legacy dims
+        # (h/w omitted), leaving the blob effectively flat: recover the
+        # OIHW shape from the layer hyper-parameters
+        w = w.reshape(num_out, w.size // (num_out * kh * kw), kh, kw)
     n_in = w.shape[1] * group
     m = nn.SpatialConvolution(n_in, num_out, kw, kh, sw, sh, pw_, ph,
                               n_group=group, with_bias=bias,
@@ -215,6 +220,22 @@ def _convert_layer(layer: dict, blobs: List[np.ndarray],
             st = {"running_mean": blobs[0].reshape(-1) * scale,
                   "running_var": blobs[1].reshape(-1) * scale}
         return m, ("state", st)
+    if t == "Scale":
+        # affine per-channel y = gamma*x + beta (caffe pairs this after
+        # BatchNorm; reference LayerConverter.fromCaffeScale)
+        if not blobs:
+            raise NotImplementedError(
+                f"Scale layer {name!r} without blobs: channel count "
+                "unknown (weights-free prototxt import)")
+        c = blobs[0].size
+        m = nn.Scale((c, 1, 1), name=name)
+        # no bias blob (bias_term=false, the caffe default) -> bias must
+        # be ZERO, not the CAdd random init
+        beta = (blobs[1].reshape(c, 1, 1) if len(blobs) > 1
+                else np.zeros((c, 1, 1), np.float32))
+        w = {"mul": {"weight": blobs[0].reshape(c, 1, 1)},
+             "add": {"bias": beta}}
+        return m, w
     if t in ("Input", "Data", "DummyData"):
         return None, "input"   # registers its tops as graph inputs
     if t in ("SoftmaxWithLoss", "Accuracy", "Silence"):
@@ -263,8 +284,15 @@ def load_caffe_model(def_path: str, model_path: str,
             continue  # "skip": training/diagnostic head, dropped
         bots = [nodes[b] for b in layer["bottom"] if b in nodes]
         if not bots:
-            raise ValueError(f"layer {layer['name']} has unknown bottoms "
-                             f"{layer['bottom']}")
+            if layer["bottom"]:
+                raise ValueError(f"layer {layer['name']} has unknown "
+                                 f"bottoms {layer['bottom']}")
+            # bottomless compute layer (reference persister emits the
+            # first layer with no bottom and no input decl): implicit
+            # graph input feeds it
+            n = Input()
+            inputs.append(n)
+            bots = [n]
         node = mod(bots if len(bots) > 1 else bots[0])
         for top in layer["top"]:
             nodes[top] = node

@@ -1,15 +1,15 @@
 """Model conversion CLI.
 
 Reference: ``DL/utils/ConvertModel.scala:24-46`` —
-``--from {bigdl,caffe,torch,tensorflow} --to {bigdl,...}``.  Supported
-conversion: ``bigdl → bigdl`` (re-serialize, e.g. to normalize storage
-layout).  TF/Caffe/Torch sources load and execute natively via
-``interop.load_tf_graph`` / ``load_caffe_model`` / ``load_t7`` — there is
-no structural conversion into the bigdl layer tree to re-serialize.
+``--from {bigdl,caffe,torch,tensorflow} --to {bigdl,caffe,torch}`` with
+``--prototxt`` for Caffe sources, ``--tf_inputs``/``--tf_outputs`` for
+TF sources, and ``--quantize`` for int8 post-training quantization of
+the saved model.
 
 Usage:
     python -m bigdl_tpu.interop.convert_model \
-        --from bigdl --input model.bigdl --to bigdl --output copy.bigdl
+        --from caffe --prototxt net.prototxt --input net.caffemodel \
+        --to bigdl --output model.bigdl
 """
 
 from __future__ import annotations
@@ -17,24 +17,78 @@ from __future__ import annotations
 import argparse
 
 
+def _load(args):
+    if args.src_fmt == "bigdl":
+        from bigdl_tpu.interop import load_bigdl_module
+        return load_bigdl_module(args.input)
+    if args.src_fmt == "caffe":
+        if not args.prototxt:
+            raise SystemExit("--from caffe requires --prototxt")
+        from bigdl_tpu.interop import load_caffe_model
+        return load_caffe_model(args.prototxt, args.input)
+    if args.src_fmt == "torch":
+        from bigdl_tpu.interop.torch_export import load_torch_module
+        return load_torch_module(args.input)
+    if args.src_fmt in ("tf", "tensorflow"):
+        if not (args.tf_inputs and args.tf_outputs):
+            raise SystemExit(
+                "--from tensorflow requires --tf_inputs and --tf_outputs")
+        from bigdl_tpu.interop import load_tf_graph
+        return load_tf_graph(args.input, inputs=args.tf_inputs.split(","),
+                             outputs=args.tf_outputs.split(","))
+    if args.src_fmt == "keras":
+        from bigdl_tpu.interop import load_keras_json
+        model = load_keras_json(args.input)
+        if args.weights:
+            from bigdl_tpu.interop import load_keras_hdf5_weights
+            load_keras_hdf5_weights(model, args.weights)
+        return model.core_module()
+    raise SystemExit(f"unknown source format {args.src_fmt}")
+
+
+def _save(model, args):
+    if args.dst_fmt == "bigdl":
+        from bigdl_tpu.interop import save_bigdl_module
+        save_bigdl_module(model, args.output)
+    elif args.dst_fmt == "caffe":
+        from bigdl_tpu.interop.caffe_export import save_caffe
+        proto = args.output_def or args.output + ".prototxt"
+        save_caffe(model, proto, args.output)
+    elif args.dst_fmt == "torch":
+        from bigdl_tpu.interop.torch_export import save_torch_module
+        save_torch_module(model, args.output)
+    else:
+        raise SystemExit(f"unknown target format {args.dst_fmt}")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="Convert models between formats")
     p.add_argument("--from", dest="src_fmt", required=True,
-                   choices=["bigdl"],
-                   help="source format; tensorflow/caffe/torch models "
-                        "import via interop.load_tf_graph / "
-                        "load_caffe_model / load_t7 and execute natively "
-                        "(no structural conversion to re-serialize)")
+                   choices=["bigdl", "caffe", "torch", "tf", "tensorflow",
+                            "keras"])
     p.add_argument("--to", dest="dst_fmt", required=True,
-                   choices=["bigdl"])
+                   choices=["bigdl", "caffe", "torch"])
     p.add_argument("--input", required=True, help="source model file")
     p.add_argument("--output", required=True, help="destination file")
+    p.add_argument("--prototxt", help="Caffe source net definition")
+    p.add_argument("--output-def", dest="output_def",
+                   help="Caffe target prototxt path "
+                        "(default: <output>.prototxt)")
+    p.add_argument("--tf_inputs", help="comma-separated TF input nodes")
+    p.add_argument("--tf_outputs", help="comma-separated TF output nodes")
+    p.add_argument("--weights", help="Keras HDF5 weight file")
+    p.add_argument("--quantize", action="store_true",
+                   help="int8-quantize before saving (bigdl target only, "
+                        "reference ConvertModel.scala:40)")
     args = p.parse_args(argv)
 
-    from bigdl_tpu.interop import load_bigdl_module, save_bigdl_module
-
-    model = load_bigdl_module(args.input)
-    save_bigdl_module(model, args.output)
+    model = _load(args)
+    if args.quantize:
+        if args.dst_fmt != "bigdl":
+            raise SystemExit("--quantize is only supported with --to bigdl")
+        from bigdl_tpu.nn.quantized import quantize
+        model = quantize(model)
+    _save(model, args)
     print(f"converted {args.input} ({args.src_fmt}) -> "
           f"{args.output} ({args.dst_fmt})")
 

@@ -134,64 +134,87 @@ def _load_functional_model(cfg: dict) -> "K.Model":
     (reference converter's Model path).  Each deferred wrapper builds
     once its input shape is known, walked in topological (listed) order;
     edges become ``nn.Graph`` nodes.  Multi-input layers (Merge) receive
-    a node list."""
+    a node list.
+
+    **Shared (multi-call) layers**: a layer with several
+    ``inbound_nodes`` entries is built ONCE and applied per call; the
+    resulting graph nodes share the module instance, which
+    :class:`bigdl_tpu.nn.Graph` resolves to tied weights (reference
+    converter handles multi-call layers the same way — one BigDL module,
+    many graph occurrences).  Graph tensors are keyed by
+    ``(layer_name, node_index)`` to address each call's output."""
     from bigdl_tpu.keras.layers import infer_output_shape
     from bigdl_tpu.nn.graph import Graph, Input as GInput
 
-    nodes: Dict[str, Any] = {}
-    shapes: Dict[str, tuple] = {}
+    nodes: Dict[tuple, Any] = {}
+    shapes: Dict[tuple, tuple] = {}
+
+    def src_key(ib_entry) -> tuple:
+        # inbound ref = [layer_name, node_index, tensor_index, ...]
+        return (ib_entry[0], int(ib_entry[1]) if len(ib_entry) > 1 else 0)
+
     for entry in cfg.get("layers", []):
         name = entry.get("name") or entry["config"].get("name")
         lcls = entry["class_name"]
         inbound = entry.get("inbound_nodes") or []
-        if len(inbound) > 1:
-            raise NotImplementedError(
-                f"layer {name!r} is called {len(inbound)} times (shared "
-                "layer); multi-call functional graphs are not supported")
-        srcs = [ib[0] for ib in inbound[0]] if inbound else []
         if lcls == "InputLayer":
             n = GInput()
-            nodes[name] = n
+            nodes[(name, 0)] = n
             bis = entry["config"].get("batch_input_shape")
-            shapes[name] = _batchless_shape(bis or [None])
+            shapes[(name, 0)] = _batchless_shape(bis or [None])
             continue
         if lcls == "Merge":
             cfg_m = entry["config"]
             mode = cfg_m.get("mode", "sum")
             axis = int(cfg_m.get("concat_axis", -1))
             core = K.Merge(mode=mode, concat_axis=axis).build(None)
-            in_nodes = [nodes[s] for s in srcs]
-            nodes[name] = core(in_nodes)
-            s0 = shapes[srcs[0]]
-            if mode == "concat":
-                # Keras concat_axis counts the batch dim; our bookkeeping
-                # shapes are batch-less, so positive axes shift down by 1
-                ax = axis - 1 if axis > 0 else len(s0) + axis
-                cat = list(s0)
-                cat[ax] = sum(shapes[s][ax] for s in srcs)
-                shapes[name] = tuple(cat)
-            else:
-                shapes[name] = s0
+            for call_ix, ib in enumerate(inbound):
+                srcs = [src_key(s) for s in ib]
+                nodes[(name, call_ix)] = core([nodes[s] for s in srcs])
+                s0 = shapes[srcs[0]]
+                if mode == "concat":
+                    # Keras concat_axis counts the batch dim; our
+                    # bookkeeping shapes are batch-less, so positive axes
+                    # shift down by 1
+                    ax = axis - 1 if axis > 0 else len(s0) + axis
+                    cat = list(s0)
+                    cat[ax] = sum(shapes[s][ax] for s in srcs)
+                    shapes[(name, call_ix)] = tuple(cat)
+                else:
+                    shapes[(name, call_ix)] = s0
             continue
-        wrapper = _layer_from_config(entry)
-        if len(srcs) != 1:
+        if not inbound:
             raise NotImplementedError(
-                f"layer {name!r} ({lcls}) with {len(srcs)} inbound nodes")
-        in_shape = shapes[srcs[0]]
-        core = wrapper.build(in_shape)
-        shapes[name] = infer_output_shape(core, in_shape)
-        nodes[name] = core(nodes[srcs[0]])
+                f"layer {name!r} ({lcls}) has no inbound nodes")
+        core = None
+        built_shape = None
+        for call_ix, ib in enumerate(inbound):
+            srcs = [src_key(s) for s in ib]
+            if len(srcs) != 1:
+                raise NotImplementedError(
+                    f"layer {name!r} ({lcls}) with {len(srcs)} inbound "
+                    "tensors")
+            in_shape = shapes[srcs[0]]
+            if core is None:
+                core = _layer_from_config(entry).build(in_shape)
+                built_shape = in_shape
+            elif in_shape != built_shape:
+                raise NotImplementedError(
+                    f"shared layer {name!r} called with differing input "
+                    f"shapes {built_shape} vs {in_shape}")
+            shapes[(name, call_ix)] = infer_output_shape(core, in_shape)
+            nodes[(name, call_ix)] = core(nodes[srcs[0]])
 
     # bind inputs in the DECLARED order (cfg["input_layers"]), which may
     # differ from the layer-listing order Keras serializes
-    in_names = [i[0] for i in cfg.get("input_layers", [])]
-    if not in_names:  # fall back to listing order
-        in_names = [e.get("name") or e["config"].get("name")
-                    for e in cfg.get("layers", [])
-                    if e["class_name"] == "InputLayer"]
-    inputs = [nodes[i] for i in in_names]
-    out_names = [o[0] for o in cfg.get("output_layers", [])]
-    graph = Graph(inputs, [nodes[o] for o in out_names],
+    in_keys = [src_key(i) for i in cfg.get("input_layers", [])]
+    if not in_keys:  # fall back to listing order
+        in_keys = [(e.get("name") or e["config"].get("name"), 0)
+                   for e in cfg.get("layers", [])
+                   if e["class_name"] == "InputLayer"]
+    inputs = [nodes[i] for i in in_keys]
+    out_keys = [src_key(o) for o in cfg.get("output_layers", [])]
+    graph = Graph(inputs, [nodes[o] for o in out_keys],
                   name=cfg.get("name", "KerasModel"))
     return K.Model(graph)
 

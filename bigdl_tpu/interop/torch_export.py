@@ -1,0 +1,217 @@
+"""Torch7 nn-module tree export/import over the .t7 codec.
+
+Reference: ``DL/utils/TorchFile.scala`` saves/loads whole Torch7 nn
+module objects (class name + field table), which is what
+``ConvertModel --to torch`` emits (``DL/utils/ConvertModel.scala:24-46``)
+and ``Module.loadTorch`` consumes.
+
+TPU redesign: modules are pure functional (params live in pytrees), so
+export walks ``(module, params, state)`` and materializes the mutable
+Torch field layout (weight/bias/gradWeight/gradBias arrays); import
+reverses it.  The Lua-object wire layout itself is handled by
+``torch_format._Writer``/``_Reader``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module, Sequential
+from bigdl_tpu.nn.graph import Graph
+from bigdl_tpu.interop.torch_format import load_t7, save_t7
+
+
+def _np(x):
+    return np.asarray(x, np.float64)
+
+
+def _obj(cls: str, **fields) -> Dict[str, Any]:
+    return {"_torch_class": cls,
+            "fields": {k: v for k, v in fields.items() if v is not None}}
+
+
+def _with_grads(fields: Dict[str, Any]) -> Dict[str, Any]:
+    if "weight" in fields:
+        fields["gradWeight"] = np.zeros_like(fields["weight"])
+    if "bias" in fields and fields["bias"] is not None:
+        fields["gradBias"] = np.zeros_like(fields["bias"])
+    return fields
+
+
+def module_to_torch(mod: Module, p, s) -> Dict[str, Any]:
+    """One module (+ its param/state subtree) → Torch7 object tree."""
+    if isinstance(mod, Sequential):
+        mods = [module_to_torch(c, p.get(str(i), {}), s.get(str(i), {}))
+                for i, c in enumerate(mod.modules)]
+        return _obj("nn.Sequential", modules=mods)
+    if isinstance(mod, nn.Linear):
+        f = _with_grads({"weight": _np(p["weight"]),
+                         "bias": _np(p["bias"]) if mod.with_bias else None})
+        return _obj("nn.Linear", **f)
+    if isinstance(mod, nn.SpatialConvolution):
+        kh, kw = mod.kernel
+        sh, sw = mod.stride
+        ph, pw = mod.pad
+        f = _with_grads({"weight": _np(p["weight"]),
+                         "bias": _np(p["bias"]) if mod.with_bias else None})
+        return _obj("nn.SpatialConvolution",
+                    nInputPlane=mod.n_input_plane,
+                    nOutputPlane=mod.n_output_plane,
+                    kW=kw, kH=kh, dW=sw, dH=sh, padW=pw, padH=ph, **f)
+    if isinstance(mod, nn.SpatialMaxPooling):
+        kh, kw = mod.kernel
+        sh, sw = mod.stride
+        ph, pw = mod.pad
+        return _obj("nn.SpatialMaxPooling", kW=kw, kH=kh, dW=sw, dH=sh,
+                    padW=pw, padH=ph, ceil_mode=mod.ceil_mode)
+    if isinstance(mod, nn.SpatialAveragePooling):
+        kh, kw = mod.kernel
+        sh, sw = mod.stride
+        ph, pw = mod.pad
+        return _obj("nn.SpatialAveragePooling", kW=kw, kH=kh, dW=sw, dH=sh,
+                    padW=pw, padH=ph, ceil_mode=mod.ceil_mode,
+                    count_include_pad=mod.count_include_pad)
+    if isinstance(mod, nn.SpatialBatchNormalization):
+        f: Dict[str, Any] = {"running_mean": _np(s["running_mean"]),
+                             "running_var": _np(s["running_var"]),
+                             "eps": mod.eps, "momentum": mod.momentum,
+                             "affine": mod.affine,
+                             "nOutput": mod.n_output}
+        if mod.affine:
+            f = _with_grads({**f, "weight": _np(p["weight"]),
+                             "bias": _np(p["bias"])})
+        return _obj("nn.SpatialBatchNormalization", **f)
+    if isinstance(mod, nn.LookupTable):
+        return _obj("nn.LookupTable",
+                    **_with_grads({"weight": _np(p["weight"])}))
+    if isinstance(mod, nn.SpatialCrossMapLRN):
+        return _obj("nn.SpatialCrossMapLRN", size=mod.size, alpha=mod.alpha,
+                    beta=mod.beta, k=mod.k)
+    if isinstance(mod, nn.Dropout):
+        return _obj("nn.Dropout", p=mod.p)
+    if isinstance(mod, nn.Reshape):
+        return _obj("nn.Reshape", size=list(mod.size))
+    if isinstance(mod, nn.Flatten):
+        # torch idiom for flatten-all-but-batch
+        return _obj("nn.View", numElements=-1, size=[-1])
+    simple = {nn.ReLU: "nn.ReLU", nn.Tanh: "nn.Tanh",
+              nn.Sigmoid: "nn.Sigmoid", nn.SoftMax: "nn.SoftMax",
+              nn.LogSoftMax: "nn.LogSoftMax", nn.Identity: "nn.Identity"}
+    for cls, tname in simple.items():
+        if type(mod) is cls:
+            return _obj(tname)
+    raise NotImplementedError(
+        f"no Torch7 mapping for {type(mod).__name__} "
+        "(reference TorchFile covers the classic torch nn layer set)")
+
+
+def save_torch_module(module: Module, path: str) -> None:
+    """Write ``module`` as a Torch7 nn object tree .t7 (reference
+    ``ConvertModel --to torch`` / ``TorchFile.save``)."""
+    module._ensure_init()
+    save_t7(path, module_to_torch(module, module._params, module._state))
+
+
+# --------------------------------------------------------------- importing
+def torch_to_module(tree) -> Module:
+    """Torch7 object tree (from :func:`load_t7`) → module with weights
+    (reference ``Module.loadTorch``)."""
+    if not (isinstance(tree, dict) and "_torch_class" in tree):
+        raise ValueError(f"not a torch module object: {type(tree)}")
+    cls = tree["_torch_class"].split(".")[-1]
+    f = tree.get("fields", {}) or {}
+
+    def arr(key):
+        v = f.get(key)
+        return None if v is None else np.asarray(v, np.float32)
+
+    def sized(key, default=None):
+        v = f.get(key, default)
+        return int(v) if v is not None else None
+
+    if cls == "Sequential":
+        import jax
+        children = [torch_to_module(m) for m in f.get("modules", [])]
+        seq = nn.Sequential(*children)
+        # assemble the parent pytree from the children's imported params
+        # (a later _ensure_init on the Sequential would re-init randomly)
+        for c in children:
+            c._ensure_init()
+        seq._params = {str(i): c._params for i, c in enumerate(children)}
+        seq._state = {str(i): c._state for i, c in enumerate(children)}
+        seq._grads = jax.tree_util.tree_map(np.zeros_like, seq._params)
+        return seq
+    if cls == "Linear":
+        w = arr("weight")
+        m = nn.Linear(w.shape[1], w.shape[0],
+                      with_bias=arr("bias") is not None)
+        m._set_import_params({"weight": w, "bias": arr("bias")})
+        return m
+    if cls in ("SpatialConvolution", "SpatialConvolutionMM"):
+        w = arr("weight")
+        n_out = sized("nOutputPlane", w.shape[0])
+        n_in = sized("nInputPlane")
+        kw, kh = sized("kW"), sized("kH")
+        w = w.reshape(n_out, n_in, kh, kw)
+        m = nn.SpatialConvolution(
+            n_in, n_out, kw, kh, sized("dW", 1), sized("dH", 1),
+            sized("padW", 0), sized("padH", 0),
+            with_bias=arr("bias") is not None)
+        m._set_import_params({"weight": w, "bias": arr("bias")})
+        return m
+    if cls == "SpatialMaxPooling":
+        return nn.SpatialMaxPooling(
+            sized("kW"), sized("kH"), sized("dW", 1), sized("dH", 1),
+            sized("padW", 0), sized("padH", 0),
+            ceil_mode=bool(f.get("ceil_mode", False)))
+    if cls == "SpatialAveragePooling":
+        return nn.SpatialAveragePooling(
+            sized("kW"), sized("kH"), sized("dW", 1), sized("dH", 1),
+            sized("padW", 0), sized("padH", 0),
+            ceil_mode=bool(f.get("ceil_mode", False)),
+            count_include_pad=bool(f.get("count_include_pad", True)))
+    if cls == "SpatialBatchNormalization":
+        mean = arr("running_mean")
+        m = nn.SpatialBatchNormalization(
+            sized("nOutput", mean.shape[0]),
+            eps=float(f.get("eps", 1e-5)),
+            momentum=float(f.get("momentum", 0.1)),
+            affine=bool(f.get("affine", arr("weight") is not None)))
+        m._set_import_params(
+            {"weight": arr("weight"), "bias": arr("bias")}
+            if m.affine else {},
+            {"running_mean": mean, "running_var": arr("running_var")})
+        return m
+    if cls == "LookupTable":
+        w = arr("weight")
+        m = nn.LookupTable(w.shape[0], w.shape[1])
+        m._set_import_params({"weight": w})
+        return m
+    if cls == "SpatialCrossMapLRN":
+        return nn.SpatialCrossMapLRN(
+            sized("size", 5), float(f.get("alpha", 1.0)),
+            float(f.get("beta", 0.75)), float(f.get("k", 1.0)))
+    if cls == "Dropout":
+        return nn.Dropout(float(f.get("p", 0.5)))
+    if cls == "Reshape":
+        return nn.Reshape(tuple(int(d) for d in f.get("size", [])))
+    if cls == "View":
+        size = [int(d) for d in np.ravel(np.asarray(f.get("size", [-1])))]
+        if size == [-1]:     # flatten-all-but-batch (our export idiom)
+            return nn.Flatten()
+        return nn.View(tuple(size))
+    simple = {"ReLU": nn.ReLU, "Tanh": nn.Tanh, "Sigmoid": nn.Sigmoid,
+              "SoftMax": nn.SoftMax, "LogSoftMax": nn.LogSoftMax,
+              "Identity": nn.Identity}
+    if cls in simple:
+        return simple[cls]()
+    raise NotImplementedError(f"torch class nn.{cls} is not mapped")
+
+
+def load_torch_module(path: str) -> Module:
+    """.t7 containing a Torch7 nn module tree → module (reference
+    ``Module.loadTorch``)."""
+    return torch_to_module(load_t7(path))

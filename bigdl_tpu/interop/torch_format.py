@@ -185,6 +185,15 @@ class _Writer:
             self.f.write(b)
         elif isinstance(v, np.ndarray):
             self._tensor(v)
+        elif isinstance(v, dict) and "_torch_class" in v:
+            # generic torch object (e.g. an nn module): class name +
+            # field table — the mirror of _Reader._object
+            self.i32(TYPE_TORCH)
+            self.i32(self.next_ref)
+            self.next_ref += 1
+            self._string("V 1")
+            self._string(v["_torch_class"])
+            self.write(v.get("fields", {}))
         elif isinstance(v, (dict, list, tuple)):
             self._table(v)
         else:

@@ -165,6 +165,27 @@ class Module:
         self._params = params
         return self
 
+    def _set_import_params(self, params=None, state=None) -> "Module":
+        """Importer helper: overwrite freshly-initialized params/state
+        entries with (numpy) arrays, keeping pytree structure and shapes
+        (``None`` values and missing keys are left at their init)."""
+        self._ensure_init()
+
+        def merge(dst, src):
+            for k, v in (src or {}).items():
+                if v is None:
+                    continue
+                if isinstance(v, dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = jnp.asarray(np.asarray(v), jnp.float32) \
+                        .reshape(dst[k].shape)
+
+        merge(self._params, params)
+        merge(self._state, state)
+        self._grads = jax.tree_util.tree_map(jnp.zeros_like, self._params)
+        return self
+
     # ---------------------------------------------------- spec traversal
     def spec_children(self):
         """How sharding-spec builders traverse this module

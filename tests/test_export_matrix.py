@@ -1,0 +1,251 @@
+"""Export matrix: Caffe persister, Torch7 module export/import, and the
+full ConvertModel CLI (reference ``DL/utils/caffe/CaffePersister.scala``,
+``DL/utils/ConvertModel.scala:24-46``) — VERDICT r2 missing #4."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+REF_CAFFE = "/root/reference/spark/dl/src/test/resources/caffe"
+
+
+def _cnn():
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1, name="conv1"),
+        nn.SpatialBatchNormalization(4, name="bn1"),
+        nn.ReLU(name="relu1"),
+        nn.SpatialMaxPooling(2, 2, 2, 2, ceil_mode=True, name="pool1"),
+        nn.Flatten(name="flat"),
+        nn.Linear(4 * 4 * 4, 5, name="fc"),
+        nn.SoftMax(name="prob"),
+        name="TestNet")
+    m.initialize(3)
+    # non-trivial BN stats so parity actually checks them
+    import jax.numpy as jnp
+    m._state["1"]["running_mean"] = jnp.asarray([0.1, -0.2, 0.3, 0.0])
+    m._state["1"]["running_var"] = jnp.asarray([1.5, 0.7, 1.0, 2.0])
+    return m
+
+
+class TestCaffePersister:
+    def test_roundtrip_forward_parity(self, tmp_path):
+        from bigdl_tpu.interop import save_caffe, load_caffe_model
+        m = _cnn()
+        m.evaluate()
+        x = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+        want = np.asarray(m.forward(x))
+
+        proto = str(tmp_path / "net.prototxt")
+        model = str(tmp_path / "net.caffemodel")
+        save_caffe(m, proto, model, input_shapes=[[1, 3, 8, 8]])
+        m2 = load_caffe_model(proto, model)
+        m2.evaluate()
+        got = np.asarray(m2.forward(x))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_prototxt_is_reference_shaped(self, tmp_path):
+        """The emitted prototxt parses with the same textproto parser the
+        importer applies to genuine Caffe files."""
+        from bigdl_tpu.interop import save_caffe
+        from bigdl_tpu.interop.caffe_format import _parse_prototxt
+        m = _cnn()
+        proto = str(tmp_path / "net.prototxt")
+        save_caffe(m, proto, str(tmp_path / "net.caffemodel"),
+                   input_shapes=[[1, 3, 8, 8]])
+        net = _parse_prototxt(open(proto).read())
+        types = [l["type"] for l in net["layers"]]
+        assert types == ["Convolution", "BatchNorm", "Scale", "ReLU",
+                         "Pooling", "Flatten", "InnerProduct", "Softmax"]
+        # chained bottoms/tops
+        for prev, cur in zip(net["layers"], net["layers"][1:]):
+            assert cur["bottom"] == prev["top"]
+
+    @pytest.mark.skipif(not os.path.isdir(REF_CAFFE),
+                        reason="reference checkout absent")
+    def test_reference_fixture_reexport(self, tmp_path):
+        """Import the reference's committed caffemodel, re-export, and
+        re-import: forward must agree (the CaffePersisterSpec analog)."""
+        from bigdl_tpu.interop import load_caffe_model, save_caffe
+        m = load_caffe_model(
+            os.path.join(REF_CAFFE, "test_persist.prototxt"),
+            os.path.join(REF_CAFFE, "test_persist.caffemodel"))
+        m.evaluate()
+        x = np.random.RandomState(1).rand(1, 3, 5, 5).astype(np.float32)
+        want = np.asarray(m.forward(x))
+        proto = str(tmp_path / "re.prototxt")
+        model = str(tmp_path / "re.caffemodel")
+        save_caffe(m, proto, model, input_shapes=[[1, 3, 5, 5]])
+        m2 = load_caffe_model(proto, model)
+        m2.evaluate()
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), want,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestTorchModuleExport:
+    def test_roundtrip_forward_parity(self, tmp_path):
+        from bigdl_tpu.interop import save_torch_module, load_torch_module
+        m = _cnn()
+        m.evaluate()
+        x = np.random.RandomState(2).rand(2, 3, 8, 8).astype(np.float32)
+        want = np.asarray(m.forward(x))
+        path = str(tmp_path / "net.t7")
+        save_torch_module(m, path)
+        m2 = load_torch_module(path)
+        m2.evaluate()
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), want,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_t7_tree_has_torch_classes(self, tmp_path):
+        from bigdl_tpu.interop import save_torch_module, load_t7
+        m = _cnn()
+        path = str(tmp_path / "net.t7")
+        save_torch_module(m, path)
+        tree = load_t7(path)
+        assert tree["_torch_class"] == "nn.Sequential"
+        classes = [c["_torch_class"] for c in tree["fields"]["modules"]]
+        assert classes == ["nn.SpatialConvolution",
+                           "nn.SpatialBatchNormalization", "nn.ReLU",
+                           "nn.SpatialMaxPooling", "nn.View", "nn.Linear",
+                           "nn.SoftMax"]
+        conv = tree["fields"]["modules"][0]["fields"]
+        assert conv["weight"].shape == (4, 3, 3, 3)
+        assert conv["gradWeight"].shape == (4, 3, 3, 3)
+
+
+class TestBigDLGraphSerialization:
+    """nn.Graph <-> BigDL protobuf StaticGraph scheme (reference
+    ``Graph.scala:563`` GraphSerializable) — graphs previously could not
+    be saved in the native checkpoint format at all."""
+
+    def test_branchy_graph_roundtrip(self, tmp_path):
+        from bigdl_tpu.interop import save_bigdl_module, load_bigdl_module
+        from bigdl_tpu.nn.graph import Graph, Input
+        inp = Input()
+        h = nn.Linear(6, 8, name="fc1")(inp)
+        A = nn.ReLU(name="act_a")(h)
+        b = nn.Tanh(name="act_b")(h)
+        out = nn.CAddTable(name="add")([A, b])
+        g = Graph([inp], [out], name="branchy")
+        g.initialize(7)
+        g.evaluate()
+        x = np.random.RandomState(6).rand(4, 6).astype(np.float32)
+        want = np.asarray(g.forward(x))
+
+        path = str(tmp_path / "g.bigdl")
+        save_bigdl_module(g, path)
+        g2 = load_bigdl_module(path)
+        g2.evaluate()
+        np.testing.assert_allclose(np.asarray(g2.forward(x)), want,
+                                   rtol=1e-5)
+
+    def test_shared_layer_graph_roundtrip_stays_tied(self, tmp_path):
+        import jax
+        from bigdl_tpu.interop import save_bigdl_module, load_bigdl_module
+        from bigdl_tpu.nn.graph import Graph, Input
+        inp = Input()
+        shared = nn.Linear(5, 5, name="tied")
+        h1 = shared(inp)
+        h2 = shared(h1)          # same instance called twice -> tied
+        g = Graph([inp], [h2], name="tied_graph")
+        g.initialize(11)
+        g.evaluate()
+        x = np.random.RandomState(7).rand(2, 5).astype(np.float32)
+        want = np.asarray(g.forward(x))
+
+        path = str(tmp_path / "tied.bigdl")
+        save_bigdl_module(g, path)
+        g2 = load_bigdl_module(path)
+        g2.evaluate()
+        np.testing.assert_allclose(np.asarray(g2.forward(x)), want,
+                                   rtol=1e-5)
+        # still ONE param bundle after the roundtrip (weights tied)
+        assert len(jax.tree_util.tree_leaves(g2._params)) == 2
+
+    def test_shared_layer_distinct_occurrences_wire_correctly(self,
+                                                              tmp_path):
+        """Regression (r3 review): consumers of a NON-final occurrence of
+        a shared layer must not be rewired to the last occurrence."""
+        from bigdl_tpu.interop import save_bigdl_module, load_bigdl_module
+        from bigdl_tpu.nn.graph import Graph, Input
+        inp = Input()
+        shared = nn.Linear(5, 5, name="tied")
+        h1 = shared(inp)
+        h2 = shared(h1)
+        out = nn.CAddTable(name="add")([h1, h2])   # h1 used AND h2 used
+        g = Graph([inp], [out], name="occ_graph")
+        g.initialize(13)
+        g.evaluate()
+        x = np.random.RandomState(8).rand(2, 5).astype(np.float32)
+        want = np.asarray(g.forward(x))
+        path = str(tmp_path / "occ.bigdl")
+        save_bigdl_module(g, path)
+        g2 = load_bigdl_module(path)
+        g2.evaluate()
+        np.testing.assert_allclose(np.asarray(g2.forward(x)), want,
+                                   rtol=1e-5)
+
+
+class TestConvertModelCLI:
+    def _mlp(self):
+        m = nn.Sequential(nn.Linear(6, 4, name="fc1"), nn.ReLU(),
+                          nn.Linear(4, 2, name="fc2"), name="MLP")
+        m.initialize(5)
+        return m
+
+    def test_bigdl_to_torch_to_bigdl(self, tmp_path):
+        from bigdl_tpu.interop import save_bigdl_module, load_bigdl_module
+        from bigdl_tpu.interop.convert_model import main
+        m = self._mlp()
+        m.evaluate()
+        x = np.random.RandomState(3).rand(3, 6).astype(np.float32)
+        want = np.asarray(m.forward(x))
+        src = str(tmp_path / "m.bigdl")
+        t7 = str(tmp_path / "m.t7")
+        back = str(tmp_path / "back.bigdl")
+        save_bigdl_module(m, src)
+        main(["--from", "bigdl", "--input", src, "--to", "torch",
+              "--output", t7])
+        main(["--from", "torch", "--input", t7, "--to", "bigdl",
+              "--output", back])
+        m2 = load_bigdl_module(back)
+        m2.evaluate()
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), want,
+                                   rtol=1e-5)
+
+    def test_bigdl_to_caffe(self, tmp_path):
+        from bigdl_tpu.interop import save_bigdl_module, load_caffe_model
+        from bigdl_tpu.interop.convert_model import main
+        m = _cnn()
+        m.evaluate()
+        x = np.random.RandomState(4).rand(1, 3, 8, 8).astype(np.float32)
+        want = np.asarray(m.forward(x))
+        src = str(tmp_path / "m.bigdl")
+        save_bigdl_module(m, src)
+        out = str(tmp_path / "m.caffemodel")
+        main(["--from", "bigdl", "--input", src, "--to", "caffe",
+              "--output", out])
+        m2 = load_caffe_model(out + ".prototxt", out)
+        m2.evaluate()
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), want,
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.skipif(not os.path.isdir(REF_CAFFE),
+                        reason="reference checkout absent")
+    def test_caffe_to_bigdl(self, tmp_path):
+        from bigdl_tpu.interop import load_bigdl_module
+        from bigdl_tpu.interop.convert_model import main
+        out = str(tmp_path / "m.bigdl")
+        main(["--from", "caffe",
+              "--prototxt", os.path.join(REF_CAFFE,
+                                         "test_persist.prototxt"),
+              "--input", os.path.join(REF_CAFFE,
+                                      "test_persist.caffemodel"),
+              "--to", "bigdl", "--output", out])
+        m = load_bigdl_module(out)
+        m.evaluate()
+        y = m.forward(np.random.RandomState(5)
+                      .rand(1, 3, 5, 5).astype(np.float32))
+        assert np.asarray(y).shape[-1] == 2

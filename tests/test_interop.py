@@ -679,8 +679,12 @@ def test_keras_functional_positive_concat_axis():
     assert out.shape == (2, 2, 8, 8)
 
 
-def test_keras_functional_shared_layer_rejected():
+def test_keras_functional_shared_layer_tied_weights():
+    """A layer called twice imports as ONE module applied at two graph
+    positions; nn.Graph ties the weights (reference converter's
+    multi-call layer path — was rejected before r3)."""
     import json
+    import jax
     from bigdl_tpu.interop import load_keras_json
     doc = json.dumps({
         "class_name": "Model",
@@ -695,8 +699,27 @@ def test_keras_functional_shared_layer_rejected():
             "input_layers": [["i", 0, 0]],
             "output_layers": [["d", 1, 0]],
         }})
-    with pytest.raises(NotImplementedError, match="shared"):
-        load_keras_json(doc)
+    m = load_keras_json(doc)
+    core = m.core_module()
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    out = np.asarray(core.forward(x))
+    # tied weights -> exactly one (weight, bias) pair in the whole tree
+    leaves = jax.tree_util.tree_leaves(core._params)
+    assert len(leaves) == 2
+
+    def find(p, key):
+        if isinstance(p, dict):
+            if key in p and not isinstance(p[key], dict):
+                return np.asarray(p[key])
+            for v in p.values():
+                got = find(v, key)
+                if got is not None:
+                    return got
+        return None
+
+    w, b = find(core._params, "weight"), find(core._params, "bias")
+    y1 = x @ w.T + b
+    np.testing.assert_allclose(out, y1 @ w.T + b, rtol=1e-5)
 
 
 class TestTFWhileLoopImport:
