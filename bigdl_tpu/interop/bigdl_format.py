@@ -281,7 +281,7 @@ def _build(node: dict) -> Module:
             return nn.SpatialCrossMapLRN(
                 size=int(a.get("size", 5)), alpha=float(a.get("alpha", 1.0)),
                 beta=float(a.get("beta", 0.75)), k=float(a.get("k", 1.0)),
-                name=name)
+                format=a.get("format", "NCHW"), name=name)
         if t == "Dropout":
             return nn.Dropout(float(a.get("initP", 0.5)), name=name)
         if t == "Scale":
@@ -530,7 +530,8 @@ class _Exporter:
             return {"size": _enc_attr_int(m.size),
                     "alpha": _enc_attr_double(m.alpha),
                     "beta": _enc_attr_double(m.beta),
-                    "k": _enc_attr_double(m.k)}
+                    "k": _enc_attr_double(m.k),
+                    "format": _enc_attr_format(m.format)}
         if t == "Dropout":
             return {"initP": _enc_attr_double(m.p)}
         if t == "Scale":
@@ -555,6 +556,13 @@ class _Exporter:
     def encode(self, m: Module, params, state, pre=(), nxt=(),
                name: Optional[str] = None, with_params: bool = True) -> bytes:
         from bigdl_tpu.nn.graph import Graph as _Graph
+        from bigdl_tpu.nn.module import Remat as _Remat
+        if isinstance(m, _Remat):
+            # pure execution hint (recompute-in-backward): serialize the
+            # wrapped module — params/state trees are identical
+            return self.encode(m.inner, params, state, pre, nxt,
+                               name=name or m.inner.name,
+                               with_params=with_params)
         if isinstance(m, _Graph):
             return self.encode_graph(m, params, state, pre, nxt)
         t = type(m).__name__

@@ -57,6 +57,10 @@ def _convert(mod: Module, p, s, name: str) -> List[Tuple[str, str, list]]:
         sh, sw = mod.stride
         ph, pw_ = mod.pad
         dh, dw = mod.dilation
+        if dh != dw:
+            raise NotImplementedError(
+                f"Caffe dilation is isotropic; conv {name!r} has "
+                f"dilation {(dh, dw)}")
         txt = (f"  convolution_param {{\n"
                f"    num_output: {mod.n_output_plane}\n"
                f"    bias_term: {'true' if mod.with_bias else 'false'}\n"
@@ -137,6 +141,10 @@ def _convert(mod: Module, p, s, name: str) -> List[Tuple[str, str, list]]:
 def _emit(mod: Module, p, s, bottom: str, layers: List[_Layer],
           used: Dict[str, int]) -> str:
     """Emit `mod` (expanding Sequential chains), return its top name."""
+    from bigdl_tpu.nn.module import Remat
+    if isinstance(mod, Remat):
+        # execution hint only — export the wrapped module
+        return _emit(mod.inner, p, s, bottom, layers, used)
     if isinstance(mod, Sequential):
         top = bottom
         for i, child in enumerate(mod.modules):

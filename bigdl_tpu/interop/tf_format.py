@@ -113,6 +113,13 @@ def _decode_attr_value(data: bytes) -> Any:
             return list(lm[2])
         if 5 in lm:
             return [bool(v) for v in pw.ints(lm, 5)]
+        if 7 in lm:                          # list(shape) — ParseExample
+            out = []
+            for sh in lm[7]:
+                sm2 = pw.decode_message(sh)
+                out.append([pw.as_sint(pw.decode_message(d).get(1, [0])[0])
+                            for d in sm2.get(2, [])])
+            return out
         return []
     return None
 
@@ -432,16 +439,18 @@ class TFGraphModule(Module):
             if node["op"] in ("Placeholder", "PlaceholderV2") \
                     or nm in feed_points:
                 continue
-            if nm in self._node_frame and self._node_frame[nm].error:
-                raise NotImplementedError(self._node_frame[nm].error)
+            if nm in self._node_frame:
+                err = self._node_frame[nm].nest_error()
+                if err:
+                    raise NotImplementedError(err)
             if node["op"] == "Exit" and nm in self._node_frame:
-                # pull the whole frame + every external input it reads
+                # pull the whole frame NEST + every external input it reads
                 fr = self._node_frame[nm]
-                for inm in fr.interior:
+                for inm in fr.all_interior():
                     if inm not in seen:
                         seen.add(inm)
                         needed.append(inm)
-                stack.extend(fr.externals)
+                stack.extend(fr.all_externals())
                 continue
             for inp in node["inputs"]:
                 b, ix = _base_name(inp)
@@ -481,9 +490,11 @@ class TFGraphModule(Module):
             state[nm] = 1
             node = self.by_name[nm]
             fr = self._node_frame.get(nm)
-            if fr is not None and node["op"] == "Exit":
-                # an Exit depends on every EXTERNAL input of its frame
-                for b in fr.externals:
+            top_exit = (fr is not None and node["op"] == "Exit"
+                        and fr.parent is None)
+            if top_exit:
+                # an Exit depends on every EXTERNAL input of its nest
+                for b in fr.all_externals():
                     if b in self.needed:
                         visit(b)
             elif fr is not None:
@@ -496,7 +507,7 @@ class TFGraphModule(Module):
                     if ix >= 0 and b in self.needed:
                         visit(b)
             state[nm] = 2
-            if fr is None or node["op"] == "Exit":
+            if fr is None or top_exit:
                 order.append(nm)
 
         import sys
@@ -512,10 +523,11 @@ class TFGraphModule(Module):
         for o in outputs:
             b = _base_name(o)[0]
             fr = self._node_frame.get(b)
-            if fr is not None and self.by_name[b]["op"] != "Exit":
+            if fr is not None and (self.by_name[b]["op"] != "Exit"
+                                   or fr.parent is not None):
                 raise NotImplementedError(
                     f"output {o!r} is inside while frame {fr.name!r}; "
-                    "only Exit values of a loop are addressable")
+                    "only Exit values of a TOP-LEVEL loop are addressable")
         self.order = order
         self._fold_constants()
 
@@ -608,6 +620,30 @@ class TFGraphModule(Module):
                 memo[nm] = bind[nm]
                 return bind[nm]
             if nm not in fr.interior:
+                sub = self._node_frame.get(nm)
+                if sub is not None and sub is not fr \
+                        and sub.parent is not None:
+                    # NESTED frame's Exit demanded by this body: run the
+                    # child loop as one fused sub-loop, resolving its
+                    # outer inputs through THIS evaluation context
+                    # (reference FrameManager parent/child frames,
+                    # Scheduler.scala:104-145)
+                    err = sub.nest_error()
+                    if err:
+                        raise NotImplementedError(err)
+
+                    class _Ctx:
+                        def __getitem__(_self, key):
+                            if key in memo or key in bind \
+                                    or key in fr.interior:
+                                return ev(key)
+                            return values[key]
+
+                        def __setitem__(_self, key, val):
+                            memo[key] = val
+
+                    self._run_frame(sub, _Ctx())
+                    return memo[nm]
                 return values[nm]  # port/tag handling at the consumer
             node = self.by_name[nm]
             op = node["op"]
@@ -689,7 +725,17 @@ class TFGraphModule(Module):
                 outs.append(jnp.asarray(v, c.dtype).reshape(c.shape))
             return tuple(outs)
 
-        final = lax.while_loop(cond, body, carry0)
+        # bounded loop with a statically recoverable trip count → scan
+        # (reverse-differentiable, so imported graphs with loops TRAIN);
+        # else dynamic while_loop (forward-only, a JAX fundamental)
+        from bigdl_tpu.interop.tf_loops import static_trip_count
+        n_trip = static_trip_count(fr, self.by_name, self._try_const_eval)
+        if n_trip is not None:
+            def scan_body(carry, _):
+                return body(carry), None
+            final, _ = lax.scan(scan_body, carry0, None, length=n_trip)
+        else:
+            final = lax.while_loop(cond, body, carry0)
 
         # each Exit's input chains (through Switch:0) to a Merge
         merge_ix = {m["name"]: i for i, m in enumerate(fr.merges)}
@@ -715,19 +761,38 @@ class TFGraphModule(Module):
         import jax.numpy as jnp
         values: Dict[str, Any] = {}
         if isinstance(input, dict):
-            # normalize: users may feed by 'x' or port-suffixed 'x:0'
-            feeds = {_base_name(k)[0]: v for k, v in input.items()}
+            # normalize: users may feed by 'x' or port-suffixed 'x:0';
+            # feeding SEVERAL ports of one node ('parse', 'parse:1' — the
+            # ParseExample idiom) assembles a tuple value
+            port_feeds: Dict[str, Dict[int, Any]] = {}
+            for k, v in input.items():
+                b, ix = _base_name(k)
+                port_feeds.setdefault(b, {})[max(ix, 0)] = v
+            feeds = {}
+            for b, pf in port_feeds.items():
+                if len(pf) == 1 and 0 in pf:
+                    feeds[b] = jnp.asarray(pf[0])
+                else:
+                    hi = max(pf)
+                    missing = [i for i in range(hi + 1) if i not in pf]
+                    if missing:
+                        raise ValueError(
+                            f"feed {b!r}: ports {missing} not fed (got "
+                            f"{sorted(pf)})")
+                    feeds[b] = tuple(jnp.asarray(pf[i])
+                                     for i in range(hi + 1))
         else:
             if len(self.input_names) != 1:
                 raise ValueError(
                     f"graph has inputs {self.input_names}; feed a dict")
-            feeds = {_base_name(self.input_names[0])[0]: input}
+            feeds = {_base_name(self.input_names[0])[0]:
+                     jnp.asarray(input)}
         for nm in self.order:
             node = self.by_name[nm]
             op = node["op"]
             if op in ("Placeholder", "PlaceholderV2") \
                     or nm in self.feed_points:
-                values[nm] = jnp.asarray(feeds[nm])
+                values[nm] = feeds[nm]
             elif nm in self._folded:
                 values[nm] = self._folded[nm]
             elif op in ("VariableV2", "Variable"):

@@ -1,11 +1,14 @@
-"""TF v1 while-loop frame reconstruction → ``lax.while_loop``.
+"""TF v1 while-loop frame reconstruction → ``lax.while_loop``/``lax.scan``.
 
 Reference: ``DL/nn/tf/ControlOps.scala`` (Enter/Exit/NextIteration/
 LoopCondition/Switch/Merge) executed by the dataflow ``Scheduler``
-(``DL/nn/Scheduler.scala:104-145``) with dead-token propagation.
+(``DL/nn/Scheduler.scala:104-145``) with dead-token propagation and
+arbitrary frame NESTING (``FrameManager`` parent/child frames).
 
-TPU redesign: a loop frame compiles to ONE ``lax.while_loop``.  The v1
-wiring per loop variable is
+TPU redesign: a loop frame compiles to ONE ``lax.while_loop`` (or a
+``lax.scan`` when the trip count is statically recoverable — see
+``static_trip_count`` — which restores reverse-mode differentiability
+for bounded loops).  The v1 wiring per loop variable is
 
     outer ──Enter(frame)──▶ Merge ◀── NextIteration ◀── body value
                               │
@@ -20,14 +23,20 @@ merges bound to the carry; ``body`` evaluates each NextIteration input
 the same way; Exit yields the final carry.  Loop-invariant Enters (no
 Merge consumer) bind straight to their outer value.
 
-Imported loops are forward-only under reverse-mode AD (lax.while_loop
-with a dynamic trip count is not reverse-differentiable) — the same
-contract as the reference, whose ``nn/ops`` control-flow execution is
-forward-only.
+**Nesting** (the reference's ``FrameManager`` parent/child): each node
+is owned by its INNERMOST frame; a parent's body evaluator treats a
+child frame as one fused sub-loop, executed when the child's Exit value
+is demanded (see ``TFGraphModule._eval_interior``).
+
+Loops whose trip count cannot be recovered statically stay
+``lax.while_loop`` and are forward-only under reverse-mode AD (a JAX
+fundamental) — the same contract as the reference's forward-only
+``nn/ops`` execution.
 
 :func:`extract_frames` groups a GraphDef's nodes by the Enter
-``frame_name`` attr and returns the per-frame wiring; the executor in
-``tf_format`` uses it to run frames as single fused steps.
+``frame_name`` attr, builds the parent/child hierarchy, and returns the
+per-frame wiring; the executor in ``tf_format`` uses it to run frames
+as single fused steps.
 """
 
 from __future__ import annotations
@@ -47,16 +56,18 @@ class LoopFrame:
 
     __slots__ = ("name", "interior", "enters", "merges", "switches",
                  "exits", "next_iterations", "loop_cond", "invariants",
-                 "error", "externals")
+                 "error", "externals", "parent", "children")
 
     def __init__(self, name: str):
         self.name = name
         self.externals: set = set()     # node names OUTSIDE the frame
-        # that interior nodes read (the frame's data dependencies)
+        # that interior nodes read (the frame's data dependencies);
+        # for a nested frame these include parent-interior names
         self.error: Optional[str] = None  # set instead of raising so an
         # UNREACHABLE malformed frame never blocks loading; the executor
         # raises only if a pruned path actually needs this frame
-        self.interior: set = set()      # node names inside the frame
+        self.interior: set = set()      # node names owned by THIS frame
+        # (descendants' nodes excluded — innermost owner wins)
         self.enters: List[dict] = []
         self.merges: List[dict] = []    # aligned with loop-var enters
         self.switches: List[dict] = []
@@ -64,13 +75,49 @@ class LoopFrame:
         self.next_iterations: List[dict] = []
         self.loop_cond: Optional[dict] = None
         self.invariants: List[dict] = []  # Enters with no Merge consumer
+        self.parent: Optional["LoopFrame"] = None
+        self.children: List["LoopFrame"] = []
+
+    # -------------------------------------------------- nest aggregates
+    def descendants(self) -> List["LoopFrame"]:
+        out = []
+        stack = list(self.children)
+        while stack:
+            f = stack.pop()
+            out.append(f)
+            stack.extend(f.children)
+        return out
+
+    def all_interior(self) -> set:
+        out = set(self.interior)
+        for d in self.descendants():
+            out |= d.interior
+        return out
+
+    def all_externals(self) -> set:
+        """External deps of the whole nest: union of per-frame externals
+        minus every name owned inside the nest."""
+        nest = self.all_interior()
+        out = set(self.externals)
+        for d in self.descendants():
+            out |= d.externals
+        return out - nest
+
+    def nest_error(self) -> Optional[str]:
+        if self.error:
+            return self.error
+        for d in self.descendants():
+            if d.error:
+                return d.error
+        return None
 
 
 def extract_frames(nodes: List[dict]) -> Dict[str, LoopFrame]:
-    """Group control-flow nodes into frames and recover per-variable
-    wiring.  Unsupported shapes (nested frames, missing LoopCond, odd
-    merge wiring) set ``frame.error`` rather than raising, so they only
-    fail if the requested outputs actually reach them."""
+    """Group control-flow nodes into frames (innermost ownership),
+    recover per-variable wiring, and link parent/child frames.
+    Unsupported shapes (missing LoopCond, odd merge wiring) set
+    ``frame.error`` rather than raising, so they only fail if the
+    requested outputs actually reach them."""
     by_name = {n["name"]: n for n in nodes}
     consumers: Dict[str, List[dict]] = {}
     for n in nodes:
@@ -79,32 +126,98 @@ def extract_frames(nodes: List[dict]) -> Dict[str, LoopFrame]:
             consumers.setdefault(base, []).append(n)
 
     frames: Dict[str, LoopFrame] = {}
+    frame_enters: Dict[str, List[dict]] = {}
     for n in nodes:
         if n["op"] == "Enter":
             fname = _attr_frame(n) or "frame"
-            frames.setdefault(fname, LoopFrame(fname)).enters.append(n)
+            frames.setdefault(fname, LoopFrame(fname))
+            frame_enters.setdefault(fname, []).append(n)
 
-    for frame in frames.values():
-        # frame membership: flood from the Enters forward until Exit
-        stack = [e["name"] for e in frame.enters]
+    # each Exit belongs to the frame its data chain entered: walk
+    # Switch→Merge→Enter along input[0] to the Enter's frame_name
+    def exit_frame(ex_node) -> Optional[str]:
+        nm = ex_node["inputs"][0].split(":")[0]
+        for _ in range(32):
+            n = by_name.get(nm)
+            if n is None or not n["inputs"] and n["op"] != "Enter":
+                return None
+            if n["op"] == "Enter":
+                return _attr_frame(n) or "frame"
+            nm = n["inputs"][0].split(":")[0]
+        return None
+
+    # ---- phase 1: flood each frame forward from its Enters, stopping
+    # only at the frame's OWN Exits (a nested frame's Exit feeds nodes
+    # that still belong to this frame)
+    flood: Dict[str, set] = {}
+    for fname, enters in frame_enters.items():
+        stack = [e["name"] for e in enters]
         seen = set(stack)
         while stack:
             nm = stack.pop()
             node = by_name[nm]
-            frame.interior.add(nm)
-            if node["op"] == "Exit":
+            if node["op"] == "Exit" and exit_frame(node) == fname:
                 continue
             for c in consumers.get(nm, []):
                 if c["name"] not in seen:
                     seen.add(c["name"])
                     stack.append(c["name"])
+        flood[fname] = seen
+
+    # ---- phase 2: hierarchy (innermost ownership).  Frame B is nested
+    # in A iff B's Enters lie inside A's flood; the innermost parent is
+    # the candidate with the smallest flood.
+    for bname, benters in frame_enters.items():
+        # ANY enter inside A's flood marks nesting (loop-var enters whose
+        # init is outer-frame data are flooded; counter enters fed by
+        # consts are not)
+        bnames = {e["name"] for e in benters}
+        cands = [a for a in frames
+                 if a != bname and (bnames & flood[a])]
+        if cands:
+            parent = min(cands, key=lambda a: len(flood[a]))
+            frames[bname].parent = frames[parent]
+            frames[parent].children.append(frames[bname])
+    owner: Dict[str, str] = {}
+    for fname in frames:
+        others = set()
+        for oname in frames:
+            if oname != fname and frames[oname].parent is not None:
+                # any frame nested (transitively) under fname claims its
+                # nodes away from fname
+                p = frames[oname]
+                anc = p.parent
+                while anc is not None:
+                    if anc.name == fname:
+                        others |= flood[oname]
+                        break
+                    anc = anc.parent
+        frames[fname].interior = flood[fname] - others
+        for nm in frames[fname].interior:
+            owner[nm] = fname
+
+    # ---- phase 3: per-frame classification over owned nodes
+    for fname, frame in frames.items():
         for nm in frame.interior:
             node = by_name[nm]
             for inp in node["inputs"]:
                 base = inp.split(":")[0]
-                if not base.startswith("^") and \
-                        base not in frame.interior:
-                    frame.externals.add(base)
+                if base.startswith("^") or base in frame.interior:
+                    continue
+                own = owner.get(base)
+                if own is not None and frames[own].parent is not None:
+                    # owned by a DESCENDANT frame (child Exit): internal
+                    # to the nest, resolved by the parent's evaluator
+                    anc = frames[own].parent
+                    nested = False
+                    while anc is not None:
+                        if anc is frame:
+                            nested = True
+                            break
+                        anc = anc.parent
+                    if nested:
+                        continue
+                frame.externals.add(base)
             op = node["op"]
             if op == "Merge":
                 frame.merges.append(node)
@@ -116,16 +229,13 @@ def extract_frames(nodes: List[dict]) -> Dict[str, LoopFrame]:
                 frame.next_iterations.append(node)
             elif op == "LoopCond":
                 frame.loop_cond = node
-            elif op == "Enter" and (_attr_frame(node) or "frame") \
-                    != frame.name:
-                frame.error = (f"nested while-loop frames ({frame.name} "
-                               f"contains {_attr_frame(node)})")
 
         # classify enters: loop variables feed a Merge; invariants don't
+        enters = frame_enters[fname]
         merge_inputs = {inp.split(":")[0]
                         for m in frame.merges for inp in m["inputs"]}
         loop_vars = []
-        for e in frame.enters:
+        for e in enters:
             (loop_vars if e["name"] in merge_inputs
              else frame.invariants).append(e)
         frame.enters = loop_vars
@@ -149,3 +259,94 @@ def extract_frames(nodes: List[dict]) -> Dict[str, LoopFrame]:
             continue
         frame.merges = ordered
     return frames
+
+
+# --------------------------------------------------- static trip counts
+def _resolve_to_merge(name: str, by_name, frame) -> Optional[str]:
+    """Follow Identity/Switch/Enter passthroughs to a Merge of `frame`;
+    return the merge's name, or None."""
+    merge_names = {m["name"] for m in frame.merges}
+    nm = name.split(":")[0]
+    for _ in range(16):
+        if nm in merge_names:
+            return nm
+        node = by_name.get(nm)
+        if node is None or node["op"] not in ("Identity", "Switch",
+                                              "NextIteration"):
+            return None
+        nm = node["inputs"][0].split(":")[0]
+    return None
+
+
+def static_trip_count(frame, by_name, const_eval) -> Optional[int]:
+    """Recover a compile-time trip count from the canonical counter
+    pattern: ``LoopCond(Less(i, K))`` with ``i`` initialized from a
+    const-foldable Enter and stepped by ``Add(i, step)`` with const
+    step.  Returns the trip count, or None (→ dynamic while_loop).
+
+    This is what lets bounded imported loops compile to ``lax.scan``
+    and therefore train under reverse-mode AD."""
+    import math
+    if frame.error or frame.loop_cond is None:
+        return None
+    cmp_nm = frame.loop_cond["inputs"][0].split(":")[0]
+    cmp_node = by_name.get(cmp_nm)
+    if cmp_node is None or cmp_node["op"] not in (
+            "Less", "LessEqual", "Greater", "GreaterEqual"):
+        return None
+    lhs, rhs = cmp_node["inputs"][0], cmp_node["inputs"][1]
+    merge_nm = _resolve_to_merge(lhs, by_name, frame)
+    limit = const_eval(rhs.split(":")[0])
+    if merge_nm is None or limit is None:
+        return None
+    limit = float(limit)
+    # counter init: the merge's Enter input's outer value
+    merge_ix = {m["name"]: i for i, m in enumerate(frame.merges)}
+    ix = merge_ix[merge_nm]
+    enter = frame.enters[ix]
+    init = const_eval(enter["inputs"][0].split(":")[0])
+    if init is None:
+        return None
+    init = float(init)
+    # counter update: NextIteration input must be Add(counter, const)
+    merge = frame.merges[ix]
+    ni_nm = None
+    for inp in merge["inputs"]:
+        b = inp.split(":")[0]
+        if b != enter["name"]:
+            ni_nm = b
+    if ni_nm is None:
+        return None
+    add = by_name.get(by_name[ni_nm]["inputs"][0].split(":")[0])
+    if add is None or add["op"] not in ("Add", "AddV2", "Sub"):
+        return None
+    if add["op"] == "Sub" and _resolve_to_merge(
+            add["inputs"][0].split(":")[0], by_name, frame) != merge_nm:
+        # Sub(K, i) is NOT i-minus-step: modeling it as one would give a
+        # wrong scan length — leave it to the dynamic while_loop
+        return None
+    step = None
+    for inp in add["inputs"]:
+        b = inp.split(":")[0]
+        if _resolve_to_merge(b, by_name, frame) == merge_nm:
+            continue
+        step = const_eval(b)
+    if step is None:
+        return None
+    step = float(step)
+    if add["op"] == "Sub":
+        step = -step
+    if step == 0:
+        return None
+    op = cmp_node["op"]
+    if op == "Less" and step > 0:
+        n = math.ceil((limit - init) / step)
+    elif op == "LessEqual" and step > 0:
+        n = math.floor((limit - init) / step) + 1
+    elif op == "Greater" and step < 0:
+        n = math.ceil((limit - init) / step)
+    elif op == "GreaterEqual" and step < 0:
+        n = math.floor((limit - init) / step) + 1
+    else:
+        return None
+    return max(int(n), 0)

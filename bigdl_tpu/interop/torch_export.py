@@ -43,6 +43,10 @@ def _with_grads(fields: Dict[str, Any]) -> Dict[str, Any]:
 
 def module_to_torch(mod: Module, p, s) -> Dict[str, Any]:
     """One module (+ its param/state subtree) → Torch7 object tree."""
+    from bigdl_tpu.nn.module import Remat
+    if isinstance(mod, Remat):
+        # execution hint only — export the wrapped module
+        return module_to_torch(mod.inner, p, s)
     if isinstance(mod, Sequential):
         mods = [module_to_torch(c, p.get(str(i), {}), s.get(str(i), {}))
                 for i, c in enumerate(mod.modules)]

@@ -14,51 +14,61 @@ from bigdl_tpu import nn
 from bigdl_tpu.nn.initialization import Xavier
 
 
-def _conv(in_c, out_c, k, stride=1, pad=0, name=""):
+def _conv(in_c, out_c, k, stride=1, pad=0, name="", format="NCHW"):
     return (nn.Sequential(name=name)
             .add(nn.SpatialConvolution(in_c, out_c, k, k, stride, stride,
                                        pad, pad, weight_init=Xavier(),
+                                       format=format,
                                        name=f"{name}_conv"))
             .add(nn.ReLU()))
 
 
-def inception_module(in_c, c1, c3r, c3, c5r, c5, pool_proj, name):
+def inception_module(in_c, c1, c3r, c3, c5r, c5, pool_proj, name,
+                     format="NCHW"):
     """4-tower module concat'd on channels (reference ``Inception_Layer_v1``)."""
-    return (nn.Concat(1, name=name)
-            .add(_conv(in_c, c1, 1, name=f"{name}_1x1"))
+    c_axis = 1 if format == "NCHW" else 3
+    return (nn.Concat(c_axis, name=name)
+            .add(_conv(in_c, c1, 1, name=f"{name}_1x1", format=format))
             .add(nn.Sequential()
-                 .add(_conv(in_c, c3r, 1, name=f"{name}_3x3r"))
-                 .add(_conv(c3r, c3, 3, pad=1, name=f"{name}_3x3")))
+                 .add(_conv(in_c, c3r, 1, name=f"{name}_3x3r",
+                            format=format))
+                 .add(_conv(c3r, c3, 3, pad=1, name=f"{name}_3x3",
+                            format=format)))
             .add(nn.Sequential()
-                 .add(_conv(in_c, c5r, 1, name=f"{name}_5x5r"))
-                 .add(_conv(c5r, c5, 5, pad=2, name=f"{name}_5x5")))
+                 .add(_conv(in_c, c5r, 1, name=f"{name}_5x5r",
+                            format=format))
+                 .add(_conv(c5r, c5, 5, pad=2, name=f"{name}_5x5",
+                            format=format)))
             .add(nn.Sequential()
-                 .add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1))
-                 .add(_conv(in_c, pool_proj, 1, name=f"{name}_pool"))))
+                 .add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1, format=format))
+                 .add(_conv(in_c, pool_proj, 1, name=f"{name}_pool",
+                            format=format))))
 
 
-def inception_v1(class_num: int = 1000) -> nn.Sequential:
+def inception_v1(class_num: int = 1000,
+                 format: str = "NCHW") -> nn.Sequential:
+    f = format
     m = (nn.Sequential(name="InceptionV1")
-         .add(_conv(3, 64, 7, 2, 3, "conv1/7x7_s2"))
-         .add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True))
-         .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
-         .add(_conv(64, 64, 1, name="conv2/3x3_reduce"))
-         .add(_conv(64, 192, 3, pad=1, name="conv2/3x3"))
-         .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
-         .add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True)))
+         .add(_conv(3, 64, 7, 2, 3, "conv1/7x7_s2", format=f))
+         .add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True, format=f))
+         .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75, format=f))
+         .add(_conv(64, 64, 1, name="conv2/3x3_reduce", format=f))
+         .add(_conv(64, 192, 3, pad=1, name="conv2/3x3", format=f))
+         .add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75, format=f))
+         .add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True, format=f)))
     # (in, 1x1, 3x3r, 3x3, 5x5r, 5x5, pool) — reference tower widths
-    m.add(inception_module(192, 64, 96, 128, 16, 32, 32, "3a"))
-    m.add(inception_module(256, 128, 128, 192, 32, 96, 64, "3b"))
-    m.add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True))
-    m.add(inception_module(480, 192, 96, 208, 16, 48, 64, "4a"))
-    m.add(inception_module(512, 160, 112, 224, 24, 64, 64, "4b"))
-    m.add(inception_module(512, 128, 128, 256, 24, 64, 64, "4c"))
-    m.add(inception_module(512, 112, 144, 288, 32, 64, 64, "4d"))
-    m.add(inception_module(528, 256, 160, 320, 32, 128, 128, "4e"))
-    m.add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True))
-    m.add(inception_module(832, 256, 160, 320, 32, 128, 128, "5a"))
-    m.add(inception_module(832, 384, 192, 384, 48, 128, 128, "5b"))
-    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(inception_module(192, 64, 96, 128, 16, 32, 32, "3a", f))
+    m.add(inception_module(256, 128, 128, 192, 32, 96, 64, "3b", f))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True, format=f))
+    m.add(inception_module(480, 192, 96, 208, 16, 48, 64, "4a", f))
+    m.add(inception_module(512, 160, 112, 224, 24, 64, 64, "4b", f))
+    m.add(inception_module(512, 128, 128, 256, 24, 64, 64, "4c", f))
+    m.add(inception_module(512, 112, 144, 288, 32, 64, 64, "4d", f))
+    m.add(inception_module(528, 256, 160, 320, 32, 128, 128, "4e", f))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True, format=f))
+    m.add(inception_module(832, 256, 160, 320, 32, 128, 128, "5a", f))
+    m.add(inception_module(832, 384, 192, 384, 48, 128, 128, "5b", f))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1, format=f))
     m.add(nn.Dropout(0.4))
     m.add(nn.Reshape((1024,)))
     m.add(nn.Linear(1024, class_num, weight_init=Xavier()))

@@ -94,9 +94,14 @@ def resnet_cifar(depth: int = 20, class_num: int = 10,
     return model
 
 
-def resnet50(class_num: int = 1000, format: str = "NCHW") -> nn.Sequential:
+def resnet50(class_num: int = 1000, format: str = "NCHW",
+             remat: bool = False) -> nn.Sequential:
     """ImageNet ResNet-50 (reference ``ResNet.apply`` ImageNet path):
-    stem 7x7/2 + maxpool, stages [3,4,6,3] bottlenecks at 64/128/256/512."""
+    stem 7x7/2 + maxpool, stages [3,4,6,3] bottlenecks at 64/128/256/512.
+
+    ``remat=True`` wraps each bottleneck in :class:`nn.Remat` so block
+    interiors are recomputed during backward instead of stored —
+    reduces HBM activation traffic/footprint (useful at large batch)."""
     fmt = format
     model = (nn.Sequential(name="ResNet50")
              .add(_conv_bn(3, 64, 7, 2, 3, "stem", fmt))
@@ -107,7 +112,8 @@ def resnet50(class_num: int = 1000, format: str = "NCHW") -> nn.Sequential:
     for mid, blocks, first_stride in cfg:
         for bi in range(blocks):
             stride = first_stride if bi == 0 else 1
-            model.add(bottleneck(in_c, mid, stride, fmt))
+            block = bottleneck(in_c, mid, stride, fmt)
+            model.add(nn.Remat(block) if remat else block)
             in_c = mid * 4
     model.add(nn.SpatialAveragePooling(7, 7, 7, 7, format=fmt))
     model.add(nn.Reshape((2048,)))

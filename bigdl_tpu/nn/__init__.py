@@ -7,7 +7,7 @@ See ``module.py`` for the functional contract that replaces
 
 from bigdl_tpu.nn.module import (
     Module, Container, Sequential, Concat, ConcatTable, ParallelTable,
-    Identity, Echo, Lambda,
+    Identity, Echo, Lambda, Remat,
 )
 from bigdl_tpu.nn.initialization import (
     InitializationMethod, Zeros, Ones, ConstInitMethod, Xavier, MsraFiller,

@@ -405,20 +405,27 @@ class SpatialCrossMapLRN(Module):
     (reference ``SpatialCrossMapLRN.scala``; AlexNet/Inception-v1 era)."""
 
     def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
-                 k: float = 1.0, name: Optional[str] = None):
+                 k: float = 1.0, format: str = "NCHW",
+                 name: Optional[str] = None):
         super().__init__(name)
         self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.format = format
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        # input NCHW; sum x^2 over a window of `size` channels
+        # sum x^2 over a window of `size` channels (channel axis by format)
         sq = input * input
         half = (self.size - 1) // 2
         extra = self.size - 1 - half
+        dims = [1, 1, 1, 1]
+        pads = [(0, 0)] * 4
+        c_axis = 1 if self.format == "NCHW" else 3
+        dims[c_axis] = self.size
+        pads[c_axis] = (half, extra)
         acc = lax.reduce_window(
             sq, 0.0, lax.add,
-            window_dimensions=(1, self.size, 1, 1),
+            window_dimensions=tuple(dims),
             window_strides=(1, 1, 1, 1),
-            padding=((0, 0), (half, extra), (0, 0), (0, 0)))
+            padding=tuple(pads))
         denom = jnp.power(self.k + (self.alpha / self.size) * acc, self.beta)
         return input / denom, state
 

@@ -342,3 +342,30 @@ class Lambda(Module):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         return self.fn(input), state
+
+
+class Remat(Module):
+    """Rematerialization wrapper: the child's activations are NOT saved
+    for backward — they are recomputed (``jax.checkpoint``).  Trades
+    FLOPs for HBM traffic/footprint; no reference analog (the reference
+    stores every ``output`` field by construction).  Use on repeated
+    blocks (residual blocks, transformer layers) when memory- or
+    bandwidth-bound."""
+
+    def __init__(self, inner: Module, policy=None,
+                 name: Optional[str] = None):
+        super().__init__(name or f"Remat[{inner.name}]")
+        self.inner = inner
+        self.policy = policy
+
+    def spec_children(self):
+        return self.inner
+
+    def init(self, rng):
+        return self.inner.init(rng)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        def fn(p, s, x, r):
+            return self.inner.apply(p, s, x, training=training, rng=r)
+        return jax.checkpoint(fn, policy=self.policy)(params, state,
+                                                      input, rng)

@@ -62,6 +62,41 @@ MODULES = {
     "spatial_batch_norm_eval": lambda: nn.SpatialBatchNormalization(4),
 }
 
+
+def _recurrent(cell_fn):
+    def make():
+        from bigdl_tpu.nn import recurrent as R
+        return nn.Recurrent(cell_fn(R))
+    return make
+
+
+# round-3 batch: recurrent cells, BN TRAINING mode (ns_* entries compare
+# the post-step running stats), embeddings, activation sweep
+MODULES.update({
+    "recurrent_lstm": _recurrent(lambda R: R.LSTM(4, 6)),
+    "recurrent_lstm_native_oracle": _recurrent(lambda R: R.LSTM(3, 5)),
+    "recurrent_gru": _recurrent(lambda R: R.GRU(4, 6)),
+    "recurrent_lstm_peephole": _recurrent(lambda R: R.LSTMPeephole(3, 5)),
+    "recurrent_rnn_tanh": _recurrent(lambda R: R.RnnCell(4, 5)),
+    "spatial_batch_norm_train": lambda: nn.SpatialBatchNormalization(3),
+    "batch_norm_1d_train": lambda: nn.BatchNormalization(6),
+    "batch_norm_1d_eval": lambda: nn.BatchNormalization(6),
+    "lookup_table": lambda: nn.LookupTable(10, 6),
+    "act_softmax": lambda: nn.SoftMax(),
+    "act_log_softmax": lambda: nn.LogSoftMax(),
+    "act_sigmoid": lambda: nn.Sigmoid(),
+    "act_tanh": lambda: nn.Tanh(),
+    "act_relu6": lambda: nn.ReLU6(),
+    "act_leaky_relu": lambda: nn.LeakyReLU(0.01),
+    "act_softsign": lambda: nn.SoftSign(),
+    "act_softshrink": lambda: nn.SoftShrink(0.5),
+    "act_hardshrink": lambda: nn.HardShrink(0.5),
+    "act_tanhshrink": lambda: nn.TanhShrink(),
+    "act_log_sigmoid": lambda: nn.LogSigmoid(),
+    "act_gelu": lambda: nn.GELU(),
+    "act_softmin": lambda: nn.SoftMin(),
+})
+
 TOL = dict(rtol=2e-4, atol=2e-5)
 
 
@@ -73,36 +108,57 @@ def _load(name):
     params = {k[2:]: z[k] for k in z.files if k.startswith("p_")}
     dparams = {k[3:]: z[k] for k in z.files if k.startswith("dp_")}
     state = {k[2:]: z[k] for k in z.files if k.startswith("s_")}
-    return z["x"], params, state, z["out"], z["dx"], dparams
+    new_state = {k[3:]: z[k] for k in z.files if k.startswith("ns_")}
+    dx = z["dx"] if "dx" in z.files else None
+    return z["x"], params, state, z["out"], dx, dparams, new_state
 
 
 @pytest.mark.parametrize("name", sorted(MODULES))
 def test_fixture_parity(name):
-    x, params, state, want_out, want_dx, want_dp = _load(name)
+    x, params, state, want_out, want_dx, want_dp, want_ns = _load(name)
     mod = MODULES[name]()
+    training = bool(want_ns)  # ns_* entries = training-mode fixture
     jparams = jax.tree_util.tree_map(
         lambda a: jnp.asarray(a, jnp.float32), params)
     jstate = jax.tree_util.tree_map(
         lambda a: jnp.asarray(a, jnp.float32), state)
-    jx = jnp.asarray(x, jnp.float32)
+    int_input = np.issubdtype(np.asarray(x).dtype, np.integer)
+    jx = jnp.asarray(x) if int_input else jnp.asarray(x, jnp.float32)
 
-    out, _ = mod.apply(jparams, jstate, jx, training=False)
+    out, new_state = mod.apply(jparams, jstate, jx, training=training)
     np.testing.assert_allclose(np.asarray(out), want_out, **TOL,
                                err_msg=f"{name}: forward mismatch")
+    for k, want in want_ns.items():
+        np.testing.assert_allclose(
+            np.asarray(new_state[k]), want, **TOL,
+            err_msg=f"{name}: updated state {k} mismatch")
+
+    if want_dx is None and not want_dp:
+        return  # forward-only oracle
 
     def loss(p, xx):
-        y, _ = mod.apply(p, jstate, xx, training=False)
+        y, _ = mod.apply(p, jstate, xx, training=training)
         return jnp.sum(y)
 
-    dp, dx = jax.grad(loss, argnums=(0, 1))(jparams, jx)
-    np.testing.assert_allclose(np.asarray(dx), want_dx, **TOL,
-                               err_msg=f"{name}: grad_input mismatch")
+    if int_input:
+        dp = jax.grad(loss)(jparams, jx)
+    else:
+        dp, dx = jax.grad(loss, argnums=(0, 1))(jparams, jx)
+        if want_dx is not None:
+            np.testing.assert_allclose(np.asarray(dx), want_dx, **TOL,
+                                       err_msg=f"{name}: grad_input "
+                                               "mismatch")
     for k, want in want_dp.items():
         np.testing.assert_allclose(np.asarray(dp[k]), want, **TOL,
                                    err_msg=f"{name}: grad_{k} mismatch")
 
 
 # -------------------------------------------------------------- criterions
+def _td_mse():
+    c = nn.TimeDistributedCriterion(nn.MSECriterion())
+    return c
+
+
 CRITERIONS = {
     "mse": lambda: nn.MSECriterion(),
     "abs": lambda: nn.AbsCriterion(),
@@ -114,6 +170,28 @@ CRITERIONS = {
     "soft_margin": lambda: nn.SoftMarginCriterion(),
     "hinge_embedding": lambda: nn.HingeEmbeddingCriterion(margin=1.0),
     "multilabel_soft_margin": lambda: nn.MultiLabelSoftMarginCriterion(),
+    # round-3 batch: remaining criterion families
+    "cross_entropy": lambda: nn.CrossEntropyCriterion(),
+    "class_nll_ignore": lambda: nn.ClassNLLCriterion(ignore_index=-100),
+    "bce_logits": lambda: nn.BCEWithLogitsCriterion(),
+    "multilabel_margin": lambda: nn.MultiLabelMarginCriterion(),
+    "multi_margin_p1": lambda: nn.MultiMarginCriterion(p=1),
+    "multi_margin_p2": lambda: nn.MultiMarginCriterion(p=2),
+    "margin": lambda: nn.MarginCriterion(),
+    "poisson": lambda: nn.PoissonCriterion(),
+    "mape": lambda: nn.MeanAbsolutePercentageCriterion(),
+    "msle": lambda: nn.MeanSquaredLogarithmicCriterion(),
+    "kl_probs": lambda: nn.KullbackLeiblerDivergenceCriterion(),
+    "cosine_distance": lambda: nn.CosineDistanceCriterion(),
+    "cosine_proximity": lambda: nn.CosineProximityCriterion(),
+    "dot_product": lambda: nn.DotProductCriterion(),
+    "l1_cost": lambda: nn.L1Cost(),
+    "dice": lambda: nn.DiceCoefficientCriterion(epsilon=1.0),
+    "pg": lambda: nn.PGCriterion(),
+    "categorical_ce": lambda: nn.CategoricalCrossEntropy(),
+    "softmax_with": lambda: nn.SoftmaxWithCriterion(),
+    "time_distributed_mse": _td_mse,
+    "class_simplex": lambda: nn.ClassSimplexCriterion(4),
 }
 
 
@@ -128,7 +206,38 @@ def test_criterion_fixture_parity(name):
     t = jnp.asarray(z["target"])
     loss = crit.apply(x, t)
     np.testing.assert_allclose(float(loss), float(z["loss"]), rtol=2e-4,
-                               err_msg=f"{name}: loss mismatch")
+                               atol=1e-6, err_msg=f"{name}: loss mismatch")
     dx = jax.grad(lambda xx: crit.apply(xx, t))(x)
     np.testing.assert_allclose(np.asarray(dx), z["dx"], **TOL,
                                err_msg=f"{name}: grad mismatch")
+
+
+# ---------------------------------------------- pair-input criterions
+CRITERIONS2 = {
+    "margin_ranking": lambda: nn.MarginRankingCriterion(margin=1.0),
+    "cosine_embedding": lambda: nn.CosineEmbeddingCriterion(margin=0.2),
+    "l1_hinge_embedding": lambda: nn.L1HingeEmbeddingCriterion(margin=1.0),
+    "kld_vae": lambda: nn.KLDCriterion(),
+    "gaussian": lambda: nn.GaussianCriterion(),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CRITERIONS2))
+def test_pair_criterion_fixture_parity(name):
+    path = os.path.join(DATA_DIR, f"crit2_{name}.npz")
+    if not os.path.exists(path):
+        pytest.skip("fixture not generated")
+    z = np.load(path)
+    crit = CRITERIONS2[name]()
+    x1 = jnp.asarray(z["x1"], jnp.float32)
+    x2 = jnp.asarray(z["x2"], jnp.float32)
+    t = jnp.asarray(z["target"])
+    loss = crit.apply((x1, x2), t)
+    np.testing.assert_allclose(float(loss), float(z["loss"]), rtol=2e-4,
+                               err_msg=f"{name}: loss mismatch")
+    d1, d2 = jax.grad(lambda a, b: crit.apply((a, b), t),
+                      argnums=(0, 1))(x1, x2)
+    np.testing.assert_allclose(np.asarray(d1), z["dx1"], **TOL,
+                               err_msg=f"{name}: grad x1 mismatch")
+    np.testing.assert_allclose(np.asarray(d2), z["dx2"], **TOL,
+                               err_msg=f"{name}: grad x2 mismatch")
