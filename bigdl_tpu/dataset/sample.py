@@ -82,10 +82,17 @@ class MiniBatch:
 class PaddingParam:
     """Variable-length padding config (reference ``Transformer.scala``
     PaddingParam): pad every sequence in the batch to the longest (or to
-    ``fixed_length``) with ``padding_value``."""
+    ``fixed_length``) with ``padding_value``.
+
+    ``buckets``: pad to the smallest listed length >= the batch's
+    natural max instead — under XLA each distinct padded length is a
+    separate compile, so bucketing bounds the compile count to
+    ``len(buckets)`` (the SURVEY §7 "recompilation storms" mitigation;
+    the reference pads per-batch because the JVM has no such cost)."""
 
     padding_value: float = 0.0
     fixed_length: Optional[int] = None
+    buckets: Optional[Sequence[int]] = None
 
 
 def _stack_padded(arrays: Sequence[np.ndarray], param: Optional[PaddingParam]):
@@ -97,6 +104,13 @@ def _stack_padded(arrays: Sequence[np.ndarray], param: Optional[PaddingParam]):
         raise ValueError(
             f"ragged samples {sorted(shapes)} need a PaddingParam")
     max_len = param.fixed_length or max(a.shape[0] for a in arrays)
+    if param.buckets is not None and param.fixed_length is None:
+        fitting = [b for b in sorted(param.buckets) if b >= max_len]
+        if not fitting:
+            raise ValueError(
+                f"sequence length {max_len} exceeds the largest bucket "
+                f"{max(param.buckets)}")
+        max_len = fitting[0]
     out_shape = (len(arrays), max_len) + arrays[0].shape[1:]
     out = np.full(out_shape, param.padding_value, dtype=arrays[0].dtype)
     for i, a in enumerate(arrays):

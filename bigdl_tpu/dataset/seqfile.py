@@ -115,9 +115,6 @@ def read_seqfile(path: str) -> Iterator[Tuple[bytes, bytes]]:
                 raise NotImplementedError(
                     f"SequenceFile codec {codec!r}: only DefaultCodec "
                     "(zlib) record compression is supported")
-        if block:
-            raise NotImplementedError(
-                "block-compressed SequenceFiles are not supported")
         (meta_count,) = struct.unpack(">i", f.read(4))
         for _ in range(meta_count):
             _read_hadoop_string(f)
@@ -131,6 +128,14 @@ def read_seqfile(path: str) -> Iterator[Tuple[bytes, bytes]]:
                 return _decode_bytes_writable(payload)
             return payload
 
+        if block:
+            # block compression (SequenceFile.BlockCompressWriter): each
+            # block = sync escape + sync, VInt record count, then four
+            # length-prefixed zlib buffers (key lengths, keys, value
+            # lengths, values); the length buffers hold VInts
+            yield from _read_blocks(f, sync, key_cls, val_cls, decode,
+                                    path)
+            return
         while True:
             head = f.read(4)
             if len(head) < 4:
@@ -152,13 +157,70 @@ def read_seqfile(path: str) -> Iterator[Tuple[bytes, bytes]]:
             yield decode(key_cls, key), decode(val_cls, value)
 
 
+def _read_vint_stream(f) -> int:
+    """Hadoop WritableUtils.readVInt straight off a stream (shares the
+    byte-level decoder with :func:`read_vint` — the first byte tells how
+    many more to pull)."""
+    first = f.read(1)
+    if len(first) < 1:
+        raise IOError("truncated SequenceFile: EOF inside a VInt")
+    lead = struct.unpack("b", first)[0]
+    extra = 0
+    if lead < -112:
+        extra = -(lead + 120) if lead < -120 else -(lead + 112)
+    rest = f.read(extra)
+    if len(rest) < extra:
+        raise IOError("truncated SequenceFile: EOF inside a VInt")
+    value, _ = read_vint(first + rest, 0)
+    return value
+
+
+def _vints(buf: bytes):
+    pos = 0
+    while pos < len(buf):
+        v, pos = read_vint(buf, pos)
+        yield v
+
+
+def _read_blocks(f, sync, key_cls, val_cls, decode, path):
+    while True:
+        head = f.read(4)
+        if len(head) < 4:
+            return
+        (esc,) = struct.unpack(">i", head)
+        if esc != -1 or f.read(16) != sync:
+            raise IOError(f"corrupt block sync in {path}")
+        n_records = _read_vint_stream(f)
+
+        def buf():
+            ln = _read_vint_stream(f)
+            return zlib.decompress(f.read(ln))
+
+        key_lens = list(_vints(buf()))
+        keys = buf()
+        val_lens = list(_vints(buf()))
+        vals = buf()
+        if len(key_lens) != n_records or len(val_lens) != n_records:
+            raise IOError(f"block record-count mismatch in {path}")
+        kp = vp = 0
+        for kl, vl in zip(key_lens, val_lens):
+            yield (decode(key_cls, keys[kp:kp + kl]),
+                   decode(val_cls, vals[vp:vp + vl]))
+            kp += kl
+            vp += vl
+
+
 def write_seqfile(path: str, records: Sequence[Tuple[bytes, bytes]],
                   key_cls: str = TEXT, val_cls: str = TEXT,
                   sync_interval: int = 100,
-                  compressed: bool = False) -> None:
+                  compressed: bool = False,
+                  block_compressed: bool = False) -> None:
     """Write (key, value) byte pairs as a SequenceFile
     (``BGRImgToLocalSeqFile`` analog); ``compressed=True`` uses Hadoop
-    record compression with DefaultCodec (zlib) on the values."""
+    record compression with DefaultCodec (zlib) on the values;
+    ``block_compressed=True`` writes the block format (one zlib buffer
+    per ``sync_interval`` records — what MapReduce jobs emit by
+    default)."""
     sync = np.random.default_rng(12345).bytes(16)
 
     def encode(cls, payload: bytes) -> bytes:
@@ -172,11 +234,30 @@ def write_seqfile(path: str, records: Sequence[Tuple[bytes, bytes]],
         f.write(b"SEQ" + bytes([_VERSION]))
         f.write(_hadoop_string(key_cls))
         f.write(_hadoop_string(val_cls))
-        f.write(bytes([1 if compressed else 0, 0]))
-        if compressed:
+        on = compressed or block_compressed
+        f.write(bytes([1 if on else 0, 1 if block_compressed else 0]))
+        if on:
             f.write(_hadoop_string(DEFAULT_CODEC))
         f.write(struct.pack(">i", 0))   # no metadata
         f.write(sync)
+        if block_compressed:
+            recs = list(records)
+            for start in range(0, len(recs), sync_interval):
+                chunk = recs[start:start + sync_interval]
+                kl = b"".join(write_vint(len(encode(key_cls, k)))
+                              for k, _ in chunk)
+                kb = b"".join(encode(key_cls, k) for k, _ in chunk)
+                vl = b"".join(write_vint(len(encode(val_cls, v)))
+                              for _, v in chunk)
+                vb = b"".join(encode(val_cls, v) for _, v in chunk)
+                f.write(struct.pack(">i", -1))
+                f.write(sync)
+                f.write(write_vint(len(chunk)))
+                for payload in (kl, kb, vl, vb):
+                    z = zlib.compress(payload)
+                    f.write(write_vint(len(z)))
+                    f.write(z)
+            return
         for i, (k, v) in enumerate(records):
             if i and i % sync_interval == 0:
                 f.write(struct.pack(">i", -1))

@@ -123,6 +123,10 @@ def _out_shape(m: Module, params, state, in_shape) -> tuple:
 
 def _emit(g: _GraphBuilder, m: Module, params, state, cur: str,
           shape: tuple) -> Tuple[str, tuple]:
+    from bigdl_tpu.nn.module import Remat
+    if isinstance(m, Remat):
+        # execution hint only — export the wrapped module
+        return _emit(g, m.inner, params, state, cur, shape)
     t = type(m).__name__
     if isinstance(m, Sequential):
         for i, c in enumerate(m.modules):

@@ -16,7 +16,8 @@ from bigdl_tpu.nn.initialization import (
 from bigdl_tpu.nn.layers import (
     Linear, SpatialConvolution, SpatialFullConvolution, SpatialMaxPooling,
     SpatialAveragePooling, SpatialBatchNormalization, BatchNormalization,
-    Dropout, LookupTable, SpatialCrossMapLRN, Normalize, CMul, CAdd,
+    Dropout, LookupTable, SpatialCrossMapLRN, Normalize, NormalizeScale,
+    CMul, CAdd,
     TemporalConvolution,
 )
 from bigdl_tpu.nn.activations import (
@@ -67,7 +68,8 @@ from bigdl_tpu.nn.attention import (
     LayerNorm, MultiHeadAttention, dot_product_attention,
 )
 from bigdl_tpu.nn.sparse import (
-    LookupTableSparse, SparseLinear, SparseJoinTable, dense_to_bags,
+    LookupTableSparse, SparseLinear, SparseJoinTable, DenseToSparse,
+    dense_to_bags,
 )
 from bigdl_tpu.nn.volumetric import (
     VolumetricConvolution, VolumetricMaxPooling, VolumetricAveragePooling,

@@ -447,6 +447,33 @@ class Normalize(Module):
         return input / (norm + self.eps), state
 
 
+class NormalizeScale(Module):
+    """Lp-normalize across channels, then a LEARNABLE per-channel scale
+    (reference ``NormalizeScale.scala`` — SSD's conv4_3 L2Norm layer;
+    ``scale`` is the constant init of the weight, 20 in the SSD recipe).
+
+    ``size`` is the broadcastable weight shape, e.g. ``(1, 512, 1, 1)``
+    for NCHW feature maps (matching the reference's CMul size)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10,
+                 scale: float = 1.0, size: Sequence[int] = (1,),
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.p, self.eps, self.scale = p, eps, scale
+        self.size = tuple(size)
+
+    def init(self, rng):
+        return {"weight": jnp.full(self.size, self.scale, jnp.float32)}, {}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(input * input, axis=1, keepdims=True))
+        else:
+            norm = jnp.power(jnp.sum(jnp.power(jnp.abs(input), self.p),
+                                     axis=1, keepdims=True), 1.0 / self.p)
+        return (input / (norm + self.eps)) * params["weight"], state
+
+
 class CMul(Module):
     """Learnable per-element scale, broadcast over batch
     (reference ``CMul.scala``)."""

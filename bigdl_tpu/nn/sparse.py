@@ -41,6 +41,30 @@ def dense_to_bags(dense: np.ndarray, bag_size: Optional[int] = None):
     return ids, weights
 
 
+class DenseToSparse(Module):
+    """Module form of dense → id-bag conversion (reference
+    ``DenseToSparse.scala`` emits a COO SparseTensor; here the sparse
+    representation is the fixed-width id bag, see module docstring).
+
+    ``bag_size`` must be static for XLA: the ``bag_size``
+    largest-|value| entries are kept (every non-zero when there are
+    fewer), the rest padded with id = -1.  Output is the ``(ids,
+    weights)`` pair that :class:`SparseLinear` /
+    :class:`LookupTableSparse` consume."""
+
+    def __init__(self, bag_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.bag_size = bag_size
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        from jax import lax
+        mag, idx = lax.top_k(jnp.abs(input), self.bag_size)
+        weights = jnp.take_along_axis(input, idx, axis=-1)
+        ids = jnp.where(mag > 0, idx, -1).astype(jnp.int32)
+        weights = jnp.where(mag > 0, weights, 0.0)
+        return (ids, weights), state
+
+
 class LookupTableSparse(Module):
     """Embedding bag with combiner (reference ``LookupTableSparse.scala``:
     combiner sum/mean/sqrtn over each sample's ids, optional per-id

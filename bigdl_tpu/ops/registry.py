@@ -641,10 +641,15 @@ def _to_bytes_list(x):
 def _decode_raw(attrs, data):
     dt = int(attrs.get("out_type", 1))
     mapping = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
-               6: np.int8, 9: np.int64, 17: np.uint16, 5: np.int16}
+               6: np.int8, 9: np.int64, 17: np.uint16, 5: np.int16,
+               19: np.float16, 22: np.uint32, 10: np.bool_}
+    if dt not in mapping:
+        raise NotImplementedError(f"DecodeRaw out_type {dt}")
+    dtype = np.dtype(mapping[dt])
+    if not bool(attrs.get("little_endian", True)) and dtype.itemsize > 1:
+        dtype = dtype.newbyteorder(">")
     payloads = _to_bytes_list(data)
-    out = [np.frombuffer(p, dtype=mapping.get(dt, np.uint8))
-           for p in payloads]
+    out = [np.frombuffer(p, dtype=dtype) for p in payloads]
     return np.stack(out) if len(out) > 1 else out[0]
 
 
@@ -657,12 +662,15 @@ def _decode_image(attrs, contents, channels_default=0):
     import io
     channels = int(attrs.get("channels", channels_default))
     img = Image.open(io.BytesIO(_to_bytes_list(contents)[0]))
-    if channels == 1:
-        img = img.convert("L")
-        arr = np.asarray(img, np.uint8)[:, :, None]
-    else:
-        img = img.convert("RGB")
-        arr = np.asarray(img, np.uint8)
+    if channels == 0:
+        # TF default: preserve the source image's channel count
+        channels = {"L": 1, "LA": 2, "RGBA": 4}.get(img.mode, 3)
+    mode = {1: "L", 3: "RGB", 4: "RGBA"}.get(channels)
+    if mode is None:
+        raise NotImplementedError(f"decode with channels={channels}")
+    arr = np.asarray(img.convert(mode), np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
     return arr
 
 

@@ -297,6 +297,17 @@ class DistriOptimizer(Optimizer):
                 self.train_summary.add_scalar("Throughput",
                                               state["throughput"],
                                               state["neval"])
+                # trigger-gated per-parameter histograms (reference
+                # DistriOptimizer.scala:541-573 "Parameters" summary)
+                ptrig = getattr(self.train_summary, "trigger_for",
+                                lambda _n: None)("Parameters")
+                if ptrig is not None and ptrig(state):
+                    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+                    for path, leaf in flat:
+                        tag = "Parameters/" + "/".join(
+                            str(getattr(k, "key", k)) for k in path)
+                        self.train_summary.add_histogram(
+                            tag, np.asarray(leaf), state["neval"])
 
             state["epoch_finished"] = \
                 state["records_processed_this_epoch"] >= epoch_size

@@ -87,80 +87,133 @@ class GPipe(Module):
         inits = [self.stage.init(k) for k in ks]
         params = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *[p for p, _ in inits])
-        # stages must be stateless under the pipelined schedule (BN running
-        # stats would need per-stage state plumbing); keep the empty-state
-        # template for stage_apply
-        self._stage_state = inits[0][1]
-        return params, {}
+        # per-stage STATE is stacked the same way (leading S axis) and
+        # threaded through the pipelined schedule — BN running stats work
+        state = {}
+        if jax.tree_util.tree_leaves(inits[0][1]):
+            state = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *[s for _, s in inits])
+        self._state_template = inits[0][1]
+        return params, state
 
     def stage_sharding(self) -> NamedSharding:
         """Sharding that gives each pipe rank its stage slice."""
         assert self.mesh is not None
         return NamedSharding(self.mesh, P(self.axis))
 
+    def _template(self):
+        if not hasattr(self, "_state_template"):
+            _, st = self.stage.init(jax.random.PRNGKey(0))
+            self._state_template = st
+        return self._state_template
+
     # pure single-device reference (for parity tests): sequential stages
-    def apply_reference(self, params, x):
+    def apply_reference(self, params, state, x, *, training=False):
         M = x.shape[0]
+        has_state = bool(jax.tree_util.tree_leaves(state))
         out = x.reshape((-1,) + x.shape[2:])
-        st = getattr(self, "_stage_state", {})
+        new_states = []
         for s in range(self.num_stages):
             p_s = jax.tree_util.tree_map(lambda a, s=s: a[s], params)
-            out, _ = self.stage.apply(p_s, st, out)
-        return out.reshape((M,) + x.shape[1:])
+            st_s = jax.tree_util.tree_map(lambda a, s=s: a[s], state) \
+                if has_state else self._template()
+            out, ns = self.stage.apply(p_s, st_s, out, training=training)
+            new_states.append(ns)
+        if has_state:
+            state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                           *new_states)
+        return out.reshape((M,) + x.shape[1:]), state
 
     def apply(self, params, state, input, *, training=False, rng=None):
         """Microbatched pipelined forward under shard_map.
 
-        input: (M, mb, ...) microbatches. Requires a mesh whose
-        ``self.axis`` size == num_stages."""
+        input: (M, mb, ...) microbatches with M divisible by S; the
+        microbatch axis is SHARDED over ``pipe`` (each rank holds M/S
+        microbatches — no replicated O(M·mb) feed), and outputs come
+        back the same way.  Requires a mesh whose ``self.axis`` size ==
+        num_stages."""
         if self.mesh is None:
-            return self.apply_reference(params, input), state
+            return self.apply_reference(params, state, input,
+                                        training=training)
         S, axis = self.num_stages, self.axis
         M = input.shape[0]
+        if M % S:
+            raise ValueError(f"microbatch count {M} must divide by "
+                             f"pipeline stages {S}")
+        chunk = M // S
         stage_apply = self.stage.apply
-        stage_state = getattr(self, "_stage_state", {})
+        has_state = bool(jax.tree_util.tree_leaves(state))
+        template = self._template()
 
-        def pipeline_rank(p_stage, xs):
-            # p_stage: this rank's stage params (leading axis 1); xs: all
-            # microbatches (replicated feed; rank 0 consumes them)
+        def pipeline_rank(p_stage, st_stage, xs_local):
+            # p_stage/st_stage: this rank's stage slice (leading axis 1);
+            # xs_local: this rank's (M/S, mb, ...) chunk of the feed
             p = jax.tree_util.tree_map(lambda a: a[0], p_stage)
+            st = jax.tree_util.tree_map(lambda a: a[0], st_stage) \
+                if has_state else template
             rank = lax.axis_index(axis)
             T = M + S - 1
-            buf = jnp.zeros_like(xs[0])          # current activation
-            outs = jnp.zeros_like(xs)            # collected at last rank
+            buf = jnp.zeros_like(xs_local[0])     # current activation
+            outs = jnp.zeros_like(xs_local)       # this rank's output chunk
 
             def tick(carry, t):
-                buf, outs = carry
-                # rank 0 ingests microbatch t (older ranks keep piped data)
-                feed = xs[jnp.minimum(t, M - 1)]
+                buf, outs, st = carry
+                # the owner of microbatch t contributes it; psum of the
+                # one-hot contribution = distributed queue pop for rank 0
+                owner = t // chunk
+                local_ix = jnp.clip(t - rank * chunk, 0, chunk - 1)
+                mine = jnp.where(rank == owner, xs_local[local_ix], 0.0)
+                feed = lax.psum(mine, axis)
                 x_in = jnp.where(rank == 0, feed, buf)
-                y, _ = stage_apply(p, stage_state, x_in)
-                # send to next rank; ring wraps, rank 0's incoming is unused
+                y, st_new = stage_apply(p, st, x_in, training=training)
+                # this rank's stage sees VALID data only for ticks
+                # rank <= t < rank+M: freeze state updates on bubbles
+                # (fill/drain garbage must not pollute BN stats)
+                valid = (t >= rank) & (t < rank + M)
+                if has_state:
+                    st = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(valid, new, old),
+                        st_new, st)
+                # send to next rank; ring wraps, rank 0's incoming unused
                 y_next = lax.ppermute(
                     y, axis, [(i, (i + 1) % S) for i in range(S)])
-                # last rank finished microbatch t-(S-1) at tick t
+                # last rank finished microbatch t-(S-1) at tick t: route
+                # it to the OWNING rank's output chunk (psum one-hot)
                 done_ix = t - (S - 1)
-                is_done = (rank == S - 1) & (done_ix >= 0)
+                done = jnp.where((rank == S - 1) & (done_ix >= 0), y, 0.0)
+                done = lax.psum(done, axis)
+                out_owner = jnp.maximum(done_ix, 0) // chunk
+                out_local = jnp.clip(done_ix - rank * chunk, 0, chunk - 1)
+                write = (done_ix >= 0) & (out_owner == rank)
                 outs = lax.cond(
-                    is_done,
-                    lambda o: o.at[jnp.maximum(done_ix, 0)].set(y),
+                    write,
+                    lambda o: o.at[out_local].set(done),
                     lambda o: o, outs)
-                return (y_next, outs), None
+                return (y_next, outs, st), None
 
-            (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T))
-            # broadcast results from the last rank to all (psum of one-hot)
-            outs = lax.psum(
-                jnp.where(rank == S - 1, outs, jnp.zeros_like(outs)), axis)
-            return outs
+            (buf, outs, st), _ = lax.scan(tick, (buf, outs, st),
+                                          jnp.arange(T))
+            st_out = jax.tree_util.tree_map(lambda a: a[None], st) \
+                if has_state else {}
+            return outs, st_out
 
-        from jax.experimental.shard_map import shard_map
+        try:
+            from jax import shard_map  # jax >= 0.8 (check_rep renamed)
+            kw = {"check_vma": False}
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+            kw = {"check_rep": False}
         fn = shard_map(
             pipeline_rank, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.axis), params),
-                      P()),
-            out_specs=P(),
-            check_rep=False)
-        return fn(params, input), state
+                      jax.tree_util.tree_map(lambda _: P(self.axis), state),
+                      P(self.axis)),
+            out_specs=(P(self.axis),
+                       jax.tree_util.tree_map(lambda _: P(self.axis),
+                                              state)),
+            **kw)
+        outs, new_state = fn(params, state, input)
+        return outs, new_state
 
 
 class MicrobatchedSequential(Module):

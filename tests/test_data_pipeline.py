@@ -357,19 +357,15 @@ class TestSequenceFile:
             got, pos = read_vint(b, 0)
             assert got == v and pos == len(b)
 
-    def test_rejects_block_compressed(self, tmp_path):
+    def test_block_compressed_roundtrip(self, tmp_path):
+        # r3: block compression is now READ/WRITTEN (MapReduce default
+        # output format); full coverage in test_round3_closures.py
         from bigdl_tpu.dataset import seqfile as sq
-        import struct
         p = str(tmp_path / "c.seq")
-        with open(p, "wb") as f:
-            f.write(b"SEQ\x06")
-            f.write(sq._hadoop_string(sq.TEXT))
-            f.write(sq._hadoop_string(sq.TEXT))
-            f.write(bytes([0, 1]))  # blockCompressed=True
-            f.write(struct.pack(">i", 0))
-            f.write(b"\x00" * 16)
-        with pytest.raises(NotImplementedError, match="block"):
-            list(sq.read_seqfile(p))
+        recs = [(f"k{i}".encode(), f"v{i}".encode() * 10)
+                for i in range(10)]
+        sq.write_seqfile(p, recs, sync_interval=4, block_compressed=True)
+        assert list(sq.read_seqfile(p)) == recs
 
 
 class TestBuiltinLoaders:

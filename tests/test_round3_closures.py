@@ -1,0 +1,153 @@
+"""Round-3 small closures (VERDICT r2 Next #10 + Weak #8/#9):
+NormalizeScale, module DenseToSparse, block-compressed SequenceFiles,
+trigger-gated parameter histograms, padding buckets vs recompilation."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+
+
+class TestNormalizeScale:
+    def test_l2_normalize_then_scale(self):
+        # SSD conv4_3 idiom: per-channel scale init 20
+        m = nn.NormalizeScale(p=2.0, scale=20.0, size=(1, 4, 1, 1))
+        p, _ = m.init(jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(p["weight"]), 20.0)
+        x = np.random.RandomState(0).rand(2, 4, 3, 3).astype(np.float32)
+        out, _ = m.apply(p, {}, jnp.asarray(x))
+        norm = np.sqrt((x * x).sum(axis=1, keepdims=True))
+        np.testing.assert_allclose(np.asarray(out),
+                                   20.0 * x / (norm + 1e-10), rtol=1e-5)
+
+    def test_scale_is_trainable(self):
+        m = nn.NormalizeScale(scale=2.0, size=(1, 3, 1, 1))
+        p, _ = m.init(jax.random.PRNGKey(0))
+        x = jnp.ones((1, 3, 2, 2))
+        g = jax.grad(lambda p: jnp.sum(m.apply(p, {}, x)[0] ** 2))(p)
+        assert float(jnp.sum(jnp.abs(g["weight"]))) > 0
+
+
+class TestDenseToSparse:
+    def test_bags_match_host_helper(self):
+        from bigdl_tpu.nn.sparse import dense_to_bags
+        dense = np.zeros((3, 10), np.float32)
+        dense[0, [2, 7]] = [1.5, -2.0]
+        dense[1, [0]] = [3.0]
+        m = nn.DenseToSparse(bag_size=2)
+        (ids, weights), _ = m.apply({}, {}, jnp.asarray(dense))
+        ref_ids, ref_w = dense_to_bags(dense, bag_size=2)
+        # same (id, weight) multiset per row (order may differ)
+        for r in range(3):
+            got = {(int(i), float(w))
+                   for i, w in zip(np.asarray(ids[r]),
+                                   np.asarray(weights[r])) if i >= 0}
+            want = {(int(i), float(w))
+                    for i, w in zip(ref_ids[r], ref_w[r]) if i >= 0}
+            assert got == want, (r, got, want)
+
+    def test_feeds_lookup_table_sparse(self):
+        m = nn.Sequential(nn.DenseToSparse(bag_size=3),
+                          nn.LookupTableSparse(10, 4, combiner="sum"))
+        m.initialize(0)
+        dense = np.zeros((2, 10), np.float32)
+        dense[0, 1] = 1.0
+        dense[1, [2, 5]] = [1.0, 1.0]
+        out = m.forward(jnp.asarray(dense))
+        assert np.asarray(out).shape == (2, 4)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestBlockCompressedSeqFile:
+    def test_roundtrip(self, tmp_path):
+        from bigdl_tpu.dataset.seqfile import read_seqfile, write_seqfile
+        recs = [(f"key{i}".encode(), os.urandom(50 + i * 13))
+                for i in range(23)]
+        path = str(tmp_path / "block.seq")
+        from bigdl_tpu.dataset.seqfile import BYTES_WRITABLE
+        write_seqfile(path, recs, val_cls=BYTES_WRITABLE,
+                      sync_interval=7, block_compressed=True)
+        got = list(read_seqfile(path))
+        assert got == recs
+
+    def test_header_flags(self, tmp_path):
+        from bigdl_tpu.dataset.seqfile import write_seqfile
+        path = str(tmp_path / "b.seq")
+        write_seqfile(path, [(b"k", b"v")], block_compressed=True)
+        raw = open(path, "rb").read()
+        assert raw[:4] == b"SEQ\x06"
+        # compressed + blockCompressed flags precede the codec string
+        assert b"DefaultCodec" in raw
+
+
+class TestParameterHistograms:
+    def test_trigger_gated_dump(self, tmp_path):
+        from bigdl_tpu import optim
+        from bigdl_tpu.utils.summary import TrainSummary
+        from bigdl_tpu.dataset import DataSet, SampleToMiniBatch
+        from bigdl_tpu.dataset.sample import Sample
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.rand(8).astype(np.float32),
+                          np.int32(rng.randint(0, 2)))
+                   for _ in range(64)]
+        model = nn.Sequential(nn.Linear(8, 2), nn.LogSoftMax())
+        summary = TrainSummary(str(tmp_path), "run")
+        summary.set_summary_trigger("Parameters",
+                                    optim.several_iteration(2))
+        opt = (optim.DistriOptimizer(
+                  model, DataSet.array(samples) >> SampleToMiniBatch(16),
+                  nn.ClassNLLCriterion())
+               .set_optim_method(optim.SGD(learning_rate=0.1))
+               .set_end_when(optim.max_iteration(4))
+               .set_train_summary(summary))
+        opt.optimize()
+        summary.close()
+        run_dir = str(tmp_path / "run" / "train")
+        files = [f for f in os.listdir(run_dir) if "tfevents" in f]
+        assert files, "no event file written"
+        data = open(os.path.join(run_dir, files[0]), "rb").read()
+        assert b"Parameters/" in data, "no parameter histograms in events"
+
+
+class TestPaddingBuckets:
+    def test_bucketed_padding_bounds_compiles(self):
+        """Weak #8 regression: variable-length batches with bucketed
+        padding produce at most len(buckets) distinct shapes (= XLA
+        compiles), where per-batch max padding would give one per
+        length."""
+        from bigdl_tpu.dataset.sample import (PaddingParam, Sample,
+                                              batch_samples)
+        rng = np.random.RandomState(0)
+        param = PaddingParam(padding_value=0.0, buckets=[8, 16, 32])
+        traces = []
+
+        @jax.jit
+        def step(xb):
+            traces.append(xb.shape)  # records per-TRACE, not per-call
+            return jnp.sum(xb * xb)
+
+        shapes = set()
+        for _ in range(12):
+            lens = rng.randint(3, 30, size=4)
+            samples = [Sample(rng.rand(l, 5).astype(np.float32),
+                              np.int32(0)) for l in lens]
+            mb = batch_samples(samples, feature_padding=param)
+            shapes.add(mb.input.shape)
+            step(jnp.asarray(mb.input))
+        assert len(shapes) <= 3, shapes
+        assert len(traces) <= 3, f"{len(traces)} recompiles"
+
+    def test_oversized_sequence_raises(self):
+        from bigdl_tpu.dataset.sample import (PaddingParam, Sample,
+                                              batch_samples)
+        param = PaddingParam(buckets=[4])
+        samples = [Sample(np.zeros((9, 2), np.float32), np.int32(0)),
+                   Sample(np.zeros((2, 2), np.float32), np.int32(0))]
+        with pytest.raises(ValueError, match="bucket"):
+            batch_samples(samples, feature_padding=param)
