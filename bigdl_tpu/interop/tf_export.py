@@ -82,13 +82,41 @@ class _GraphBuilder:
         self.nodes.append(pw.enc_bytes(1, body))
         return name
 
+    trainable = False  # const() emits VariableV2+Assign when True
+
     def const(self, base: str, arr) -> str:
+        arr = np.asarray(arr)
+        is_int = np.issubdtype(arr.dtype, np.integer)
+        dt = _DT_INT32 if is_int else _DT_FLOAT
+        if self.trainable and not is_int and arr.ndim >= 1:
+            # weight as a trainable VariableV2 with a Const initializer
+            # wired through Assign — the layout load_tf_graph's variable
+            # resolution consumes (reference un-frozen checkpoints)
+            name = self.fresh(base)
+            init = self.node(f"{name}/init", "Const", (),
+                             _attr_tensor("value", arr),
+                             _attr_type("dtype", dt))
+            shape = b"".join(pw.enc_bytes(2, pw.enc_varint(1, d))
+                             for d in arr.shape)
+            self.node(name, "VariableV2", (),
+                      _attr("shape", pw.enc_bytes(7, shape)),
+                      _attr_type("dtype", dt))
+            self.node(f"{name}/assign", "Assign", (name, init),
+                      _attr_type("T", dt))
+            return name
         return self.node(self.fresh(base), "Const", (),
                          _attr_tensor("value", arr),
-                         _attr_type("dtype",
-                                    _DT_INT32 if np.issubdtype(
-                                        np.asarray(arr).dtype, np.integer)
-                                    else _DT_FLOAT))
+                         _attr_type("dtype", dt))
+
+    def const_frozen(self, base: str, arr) -> str:
+        """Always a Const, regardless of ``trainable`` (for values that
+        are data, not weights — folded BN stats, shape vectors)."""
+        prev = self.trainable
+        self.trainable = False
+        try:
+            return self.const(base, arr)
+        finally:
+            self.trainable = prev
 
 
 def _pad_mode(m) -> str:
@@ -229,8 +257,12 @@ def _emit(g: _GraphBuilder, m: Module, params, state, cur: str,
         if t == "SpatialBatchNormalization" and m.format == "NCHW":
             scale = scale[:, None, None]
             shift = shift[:, None, None]
-        sc = g.const("bn_scale", scale.astype(np.float32))
-        sh = g.const("bn_shift", shift.astype(np.float32))
+        # folded running statistics are NOT weights: keep them Consts
+        # even under trainable=True (optimizing frozen normalization
+        # stats as free affine params would diverge from training the
+        # source model)
+        sc = g.const_frozen("bn_scale", scale.astype(np.float32))
+        sh = g.const_frozen("bn_shift", shift.astype(np.float32))
         out = g.node(g.fresh("bn_mul"), "Mul", (cur, sc), _attr_type("T"))
         return g.node(g.fresh("bn_add"), "Add", (out, sh),
                       _attr_type("T")), out_shape
@@ -258,17 +290,24 @@ def _emit(g: _GraphBuilder, m: Module, params, state, cur: str,
 
 def save_tf_graph(model: Module, path: str, input_shape: Sequence[int],
                   input_name: str = "input",
-                  output_name: str = "output") -> Tuple[str, str]:
-    """Export a materialized module as a frozen GraphDef (reference
+                  output_name: str = "output",
+                  trainable: bool = False) -> Tuple[str, str]:
+    """Export a materialized module as a GraphDef (reference
     ``TensorflowSaver.saveGraph``).  ``input_shape`` includes the batch
     dim (any positive placeholder batch works — shapes are only used to
     make Reshape targets static).  Returns (input_name, output_name);
-    ``load_tf_graph(path, [input], [output])`` round-trips it."""
+    ``load_tf_graph(path, [input], [output])`` round-trips it.
+
+    ``trainable=False`` freezes weights as Consts (inference export);
+    ``trainable=True`` emits them as VariableV2 nodes with Assign
+    initializers so the re-imported graph exposes them as params and
+    ``TFSession.train`` can optimize them."""
     model._ensure_init()
     import jax
     params = jax.tree_util.tree_map(np.asarray, model._params)
     state = jax.tree_util.tree_map(np.asarray, model._state)
     g = _GraphBuilder()
+    g.trainable = trainable
     g.node(input_name, "Placeholder", (), _attr_type("dtype"))
     last, _ = _emit(g, model, params, state, input_name,
                     tuple(input_shape))

@@ -67,6 +67,9 @@ from bigdl_tpu.nn.quantized import (
 from bigdl_tpu.nn.attention import (
     LayerNorm, MultiHeadAttention, dot_product_attention,
 )
+from bigdl_tpu.nn.regularizers import (
+    L1L2Regularizer, L1Regularizer, L2Regularizer, regularization_loss,
+)
 from bigdl_tpu.nn.sparse import (
     LookupTableSparse, SparseLinear, SparseJoinTable, DenseToSparse,
     dense_to_bags,

@@ -33,6 +33,7 @@ class Linear(Module):
                  weight_init: Optional[InitializationMethod] = None,
                  bias_init: Optional[InitializationMethod] = None,
                  shard: Optional[str] = None,
+                 w_regularizer=None, b_regularizer=None,
                  name: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_size
@@ -40,6 +41,10 @@ class Linear(Module):
         self.with_bias = with_bias
         self.weight_init = weight_init or RandomUniform()
         self.bias_init = bias_init or RandomUniform()
+        # per-layer penalties (reference wRegularizer/bRegularizer ctor
+        # args; collected by nn.regularizers.regularization_loss)
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
         # tensor parallelism: "column" (split output dim) / "row" (split
         # input dim) / None — see parallel/tensor_parallel.py
         self.shard = shard
@@ -96,8 +101,11 @@ class SpatialConvolution(Module):
                  format: str = "NCHW",
                  weight_init: Optional[InitializationMethod] = None,
                  bias_init: Optional[InitializationMethod] = None,
+                 w_regularizer=None, b_regularizer=None,
                  name: Optional[str] = None):
         super().__init__(name)
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
         self.kernel = (kernel_h, kernel_w)

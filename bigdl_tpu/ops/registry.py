@@ -665,7 +665,7 @@ def _decode_image(attrs, contents, channels_default=0):
     if channels == 0:
         # TF default: preserve the source image's channel count
         channels = {"L": 1, "LA": 2, "RGBA": 4}.get(img.mode, 3)
-    mode = {1: "L", 3: "RGB", 4: "RGBA"}.get(channels)
+    mode = {1: "L", 2: "LA", 3: "RGB", 4: "RGBA"}.get(channels)
     if mode is None:
         raise NotImplementedError(f"decode with channels={channels}")
     arr = np.asarray(img.convert(mode), np.uint8)

@@ -196,6 +196,19 @@ class Optimizer:
                                               training=True, rng=rng)
                 return criterion.apply(out, y), new_mstate
 
+        # per-layer L1/L2 penalties (reference Regularizer.scala applies
+        # them inside accGradParameters; here they enter the loss so
+        # jax.grad produces the identical gradient contribution)
+        from bigdl_tpu.nn.regularizers import (has_regularizers,
+                                               regularization_loss)
+        if has_regularizers(model):
+            base = loss_fn
+
+            def loss_fn(params, mstate, x, y, rng, _base=base):
+                loss, new_mstate = _base(params, mstate, x, y, rng)
+                return loss + regularization_loss(model, params), \
+                    new_mstate
+
         return jax.value_and_grad(loss_fn, has_aux=True)
 
     def _fast_forward(self, data_iter, state):

@@ -1,0 +1,63 @@
+"""Learning-quality gates (VERDICT r2 Next #7): beyond 1-epoch smoke,
+the zoo must actually LEARN.
+
+- fast gate (always on): LeNet-5 reaches >=0.99 val top-1 in 3 epochs
+  on the deterministic synthetic MNIST (the reference publishes >99%
+  for real MNIST, ``DL/models/lenet``; the synthetic stand-in is
+  template-based and equally separable).
+- real-data gates (opt-in): point ``BIGDL_MNIST_DIR`` /
+  ``BIGDL_CIFAR_DIR`` at the datasets to run the published-accuracy
+  checks (LeNet >=0.99; ResNet-20 CIFAR-10 >=0.85 within a bounded
+  epoch budget — the reference recipe reaches ~0.91 at full length,
+  ``DL/models/resnet/README.md``).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=1500):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, script), "--cpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return r.stdout
+
+
+def _final(out, key):
+    for line in out.splitlines():
+        if line.startswith("final:") and f"{key}=" in line:
+            return float(line.split(f"{key}=")[1].split()[0])
+    raise AssertionError(f"no final {key} in:\n{out[-2000:]}")
+
+
+def test_lenet_synthetic_accuracy_gate():
+    out = _run("examples/lenet/train.py", "-e", "3",
+               "--synthetic-n", "4096", "-b", "128")
+    assert _final(out, "val_top1") >= 0.99, out.splitlines()[-1]
+
+
+@pytest.mark.skipif("BIGDL_MNIST_DIR" not in os.environ,
+                    reason="set BIGDL_MNIST_DIR to run the real-MNIST "
+                           "accuracy gate")
+def test_lenet_real_mnist_gate():
+    out = _run("examples/lenet/train.py", "-e", "5", "-b", "128",
+               "-f", os.environ["BIGDL_MNIST_DIR"])
+    assert _final(out, "val_top1") >= 0.99, out.splitlines()[-1]
+
+
+@pytest.mark.skipif("BIGDL_CIFAR_DIR" not in os.environ,
+                    reason="set BIGDL_CIFAR_DIR to run the real-CIFAR "
+                           "accuracy gate (slow: ~30 epochs)")
+def test_resnet20_real_cifar_gate():
+    out = _run("examples/resnet/train_cifar10.py", "-e", "30",
+               "-b", "128", "-f", os.environ["BIGDL_CIFAR_DIR"],
+               timeout=14000)
+    assert _final(out, "val_top1") >= 0.85, out.splitlines()[-1]

@@ -90,3 +90,47 @@ def test_autoencoder_recipe():
 def test_wide_deep_recipe():
     out = _run("examples/recommender/train_wide_deep.py", "-e", "4")
     assert _final_metric(out, "train_acc") > 0.65, out
+
+
+# ---------------------------------------------------- r3 examples sweep
+def test_lenet_local_recipe():
+    out = _run("examples/lenetLocal/train.py", "-e", "1",
+               "--synthetic-n", "512", "-b", "64")
+    assert np.isfinite(_final_loss(out))
+    assert "top1=" in out
+
+
+def test_imageclassification_recipe():
+    out = _run("examples/imageclassification/predict.py",
+               "--batch-size", "8", "--classes", "4")
+    assert "predicted=16" in out
+
+
+def test_mlpipeline_recipe():
+    out = _run("examples/mlpipeline/train_classifier.py", "-e", "15")
+    assert _final_metric(out, "train_acc") > 0.9, out
+    assert _final_metric(out, "mse") < 0.01, out
+
+
+def test_tensorflow_train_imported_recipe():
+    out = _run("examples/tensorflow/train_imported.py", "-e", "4")
+    assert "reload parity: OK" in out
+    assert _final_metric(out, "train_acc") > 0.9, out
+
+
+def test_languagemodel_recipe():
+    out = _run("examples/languagemodel/train_ptb.py", "-e", "1",
+               "--vocab", "100", "--hidden", "32")
+    # synthetic Zipf corpus entropy is well under ln(100)
+    assert _final_loss(out) < 4.0, out
+
+
+def test_loadmodel_validator_recipe():
+    out = _run("examples/loadmodel/validate.py")
+    assert "formats=bigdl,torch,caffe" in out
+    assert _final_metric(out, "top1") > 0.5, out
+
+
+def test_dlframes_transfer_learning_recipe():
+    out = _run("examples/dlframes/transfer_learning.py", "-e", "8")
+    assert _final_metric(out, "train_acc") > 0.9, out
