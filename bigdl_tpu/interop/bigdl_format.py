@@ -107,6 +107,8 @@ def _decode_attr(data: bytes, storages) -> Tuple[int, Any]:
         return dtype, pw.as_str(m[7][0])
     if 8 in m:
         return dtype, bool(m[8][0])
+    if 9 in m:
+        return dtype, _dec_regularizer(m[9][0])
     if 10 in m:
         return dtype, _decode_tensor(m[10][0], storages)
     if 15 in m:  # ArrayValue
@@ -315,6 +317,11 @@ def _build(node: dict) -> Module:
             f"BigDL module type {node['module_type']!r} not mapped yet")
 
     m = ctor()
+    # re-attach per-layer penalties (reference wRegularizer/bRegularizer)
+    if a.get("wRegularizer") is not None:
+        m.w_regularizer = a["wRegularizer"]
+    if a.get("bRegularizer") is not None:
+        m.b_regularizer = a["bRegularizer"]
     m._bigdl_node = node  # stash for weight loading
     return m
 
@@ -472,6 +479,36 @@ def _enc_attr_str_array(vs) -> bytes:
     return pw.enc_varint(1, DT_ARRAY_VALUE) + pw.enc_bytes(15, av)
 
 
+def _enc_attr_regularizer(reg) -> bytes:
+    """Regularizer message (bigdl.proto): regularizerType=1 (0=L1L2,
+    1=L1, 2=L2), regularData=2 repeated double; AttrValue dataType
+    REGULARIZER=9, oneof field 9."""
+    l1 = float(getattr(reg, "l1", 0.0))
+    l2 = float(getattr(reg, "l2", 0.0))
+    if l1 and not l2:
+        rt, data = 1, [l1]
+    elif l2 and not l1:
+        rt, data = 2, [l2]
+    else:
+        rt, data = 0, [l1, l2]
+    msg = pw.enc_varint(1, rt) + b"".join(pw.enc_double(2, d)
+                                          for d in data)
+    return pw.enc_varint(1, 9) + pw.enc_bytes(9, msg)
+
+
+def _dec_regularizer(msg_bytes: bytes):
+    from bigdl_tpu.nn.regularizers import L1L2Regularizer
+    m = pw.decode_message(msg_bytes)
+    rt = pw.ints(m, 1)[0] if 1 in m else 0
+    data = [pw.as_double(v) for v in m.get(2, [])]
+    if rt == 1:
+        return L1L2Regularizer(l1=data[0] if data else 0.0)
+    if rt == 2:
+        return L1L2Regularizer(l2=data[0] if data else 0.0)
+    return L1L2Regularizer(l1=data[0] if data else 0.0,
+                           l2=data[1] if len(data) > 1 else 0.0)
+
+
 class _Exporter:
     def __init__(self):
         self.next_id = 1
@@ -483,12 +520,21 @@ class _Exporter:
 
     def module_attrs(self, m: Module) -> Dict[str, bytes]:
         t = type(m).__name__
+        out: Dict[str, bytes] = {}
+        # per-layer penalties (reference serializes wRegularizer/
+        # bRegularizer on every layer that carries them)
+        if getattr(m, "w_regularizer", None) is not None:
+            out["wRegularizer"] = _enc_attr_regularizer(m.w_regularizer)
+        if getattr(m, "b_regularizer", None) is not None:
+            out["bRegularizer"] = _enc_attr_regularizer(m.b_regularizer)
         if t == "Linear":
-            return {"inputSize": _enc_attr_int(m.input_size),
+            return {**out,
+                    "inputSize": _enc_attr_int(m.input_size),
                     "outputSize": _enc_attr_int(m.output_size),
                     "withBias": _enc_attr_bool(m.with_bias)}
         if t == "SpatialConvolution":
-            return {"nInputPlane": _enc_attr_int(m.n_input_plane),
+            return {**out,
+                    "nInputPlane": _enc_attr_int(m.n_input_plane),
                     "nOutputPlane": _enc_attr_int(m.n_output_plane),
                     "kernelW": _enc_attr_int(m.kernel[1]),
                     "kernelH": _enc_attr_int(m.kernel[0]),
@@ -547,11 +593,12 @@ class _Exporter:
         if t == "JoinTable":
             return {"dimension": _enc_attr_int(m.dimension + 1)}
         if t == "TemporalConvolution":
-            return {"inputFrameSize": _enc_attr_int(m.input_frame_size),
+            return {**out,
+                    "inputFrameSize": _enc_attr_int(m.input_frame_size),
                     "outputFrameSize": _enc_attr_int(m.output_frame_size),
                     "kernelW": _enc_attr_int(m.kernel_w),
                     "strideW": _enc_attr_int(m.stride_w)}
-        return {}
+        return out
 
     def encode(self, m: Module, params, state, pre=(), nxt=(),
                name: Optional[str] = None, with_params: bool = True) -> bytes:

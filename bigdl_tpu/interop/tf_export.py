@@ -7,9 +7,12 @@ frozen GraphDef so TF tooling can serve it.
 Scope matches the reference's converter set: Sequential chains of
 Linear / SpatialConvolution / pooling / BatchNorm (folded to scale+shift,
 inference form) / activations / Reshape / Flatten / Dropout (exported as
-Identity, like the reference's inference export).  Weights embed as Const
-nodes — a frozen graph.  Round-trip guarantee: ``load_tf_graph`` on the
-exported file reproduces the source model's outputs.
+Identity, like the reference's inference export).  Weights embed as
+Const nodes (frozen graph) by default, or as VariableV2+Assign when
+``save_tf_graph(..., trainable=True)`` — the re-imported graph then
+exposes them as params and trains via ``TFSession.train`` (folded BN
+statistics always stay Consts).  Round-trip guarantee: ``load_tf_graph``
+on the exported file reproduces the source model's outputs.
 """
 
 from __future__ import annotations

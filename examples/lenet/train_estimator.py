@@ -10,10 +10,10 @@ from bigdl_tpu.dataset import mnist
 from bigdl_tpu.estimator import NNClassifier
 from bigdl_tpu.models.lenet import lenet5
 
-x, y = mnist.synthetic_mnist(2048)
-x = ((x.reshape(-1, 1, 28, 28).astype("float32") / 255.0)
+x, y = mnist.synthetic_mnist(4096)
+x = ((x.reshape(-1, 1, 28, 28).astype("float32"))
      - mnist.TRAIN_MEAN) / mnist.TRAIN_STD
-clf = NNClassifier(lenet5(class_num=10), batch_size=128, max_epoch=2,
+clf = NNClassifier(lenet5(class_num=10), batch_size=128, max_epoch=3,
                    optim_method=optim.SGD(learning_rate=0.05, momentum=0.9))
 fitted = clf.fit(x, y)
 acc = (fitted.transform(x) == y).mean()

@@ -5,18 +5,20 @@ except ImportError:
     import os, sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from bigdl_tpu import optim
 from bigdl_tpu.dataset import mnist
 from bigdl_tpu.keras import (Convolution2D, Dense, Flatten, MaxPooling2D,
                              Sequential)
 
-x, y = mnist.synthetic_mnist(2048)
-x = ((x.reshape(-1, 1, 28, 28).astype("float32") / 255.0)
+x, y = mnist.synthetic_mnist(4096)
+x = ((x.reshape(-1, 1, 28, 28).astype("float32"))
      - mnist.TRAIN_MEAN) / mnist.TRAIN_STD
 model = Sequential([
     Convolution2D(6, 5, 5, activation="tanh", input_shape=(1, 28, 28)),
     MaxPooling2D(), Convolution2D(12, 5, 5, activation="tanh"),
     MaxPooling2D(), Flatten(), Dense(100, activation="tanh"),
     Dense(10, activation="softmax")])
-model.compile("sgd", "categorical_crossentropy", metrics=["accuracy"])
-model.fit(x, y, batch_size=128, nb_epoch=2, validation_data=(x, y))
+model.compile(optim.SGD(learning_rate=0.05, momentum=0.9),
+              "categorical_crossentropy", metrics=["accuracy"])
+model.fit(x, y, batch_size=128, nb_epoch=3, validation_data=(x, y))
 print("val:", model.evaluate(x, y))

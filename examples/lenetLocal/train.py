@@ -85,7 +85,7 @@ def main():
     # Predict.scala analog: per-sample class predictions
     pred = Predictor(reloaded, params=reloaded._params,
                      state=reloaded._state, batch_size=args.batch_size)
-    x = ((vimgs[:8].reshape(-1, 1, 28, 28).astype(np.float32) / 255.0)
+    x = ((vimgs[:8].reshape(-1, 1, 28, 28).astype(np.float32))
          - mnist.TRAIN_MEAN) / mnist.TRAIN_STD
     classes = np.argmax(np.asarray(pred.predict(x)), axis=-1)
     print(f"predictions: {classes.tolist()} (truth {vlbls[:8].tolist()})")

@@ -48,12 +48,12 @@ def main():
     print(f"logistic regression train acc: {lr_acc:.4f}")
 
     # 2) DLClassifierLeNet: the image classifier through fit/transform
-    imgs, lbls = mnist.synthetic_mnist(1024)
-    xi = ((imgs.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0)
+    imgs, lbls = mnist.synthetic_mnist(4096)
+    xi = ((imgs.reshape(-1, 1, 28, 28).astype(np.float32))
           - mnist.TRAIN_MEAN) / mnist.TRAIN_STD
     lenet_clf = NNClassifier(
-        lenet5(class_num=10), batch_size=128, max_epoch=2,
-        optim_method=optim.SGD(learning_rate=0.05, momentum=0.9))
+        lenet5(class_num=10), batch_size=128, max_epoch=3,
+        optim_method=optim.SGD(learning_rate=0.1, momentum=0.9))
     lenet_acc = (lenet_clf.fit(xi, lbls).transform(xi) == lbls).mean()
     print(f"lenet train acc: {lenet_acc:.4f}")
 

@@ -993,5 +993,146 @@ def _(rng):
                        rng.normal(0, 0.5, (4, 6)), target, loss)
 
 
+# ============================================= round-3b: tensor-math layers
+# (nn/tensor_extras.py family — MM/Bilinear/Cosine/Euclidean/Maxout/...)
+def _record_module2(name, params, x1, x2, torch_fwd):
+    """Pair-INPUT module fixture (mod2_*): input = (x1, x2)."""
+    tp = {k: _t(v).requires_grad_(True) for k, v in params.items()}
+    t1 = _t(x1).requires_grad_(True)
+    t2 = _t(x2).requires_grad_(True)
+    out = torch_fwd(tp, t1, t2)
+    out.sum().backward()
+    blob = {"x1": np.asarray(x1, np.float64),
+            "x2": np.asarray(x2, np.float64),
+            "out": out.detach().numpy(), "dx1": t1.grad.numpy(),
+            "dx2": t2.grad.numpy()}
+    for k, v in params.items():
+        blob[f"p_{k}"] = np.asarray(v, np.float64)
+        blob[f"dp_{k}"] = tp[k].grad.numpy()
+    _save(f"mod2_{name}", **blob)
+
+
+@case("mod2_bilinear")
+def _(rng):
+    # torch F.bilinear is the INDEPENDENT oracle (same (O, I1, I2) layout)
+    params = {"weight": rng.normal(0, 0.3, (5, 3, 4)),
+              "bias": rng.normal(0, 0.1, (5,))}
+    _record_module2("bilinear", params, rng.normal(0, 1, (6, 3)),
+                    rng.normal(0, 1, (6, 4)),
+                    lambda p, a, b: F.bilinear(a, b, p["weight"],
+                                               p["bias"]))
+
+
+@case("mod2_mm")
+def _(rng):
+    _record_module2("mm", {}, rng.normal(0, 1, (2, 3, 4)),
+                    rng.normal(0, 1, (2, 4, 5)),
+                    lambda p, a, b: torch.bmm(a, b))
+
+
+@case("mod2_dot_product")
+def _(rng):
+    _record_module2("dot_product", {}, rng.normal(0, 1, (4, 6)),
+                    rng.normal(0, 1, (4, 6)),
+                    lambda p, a, b: (a * b).sum(-1))
+
+
+@case("mod2_pairwise_distance")
+def _(rng):
+    _record_module2("pairwise_distance", {}, rng.normal(0, 1, (4, 6)),
+                    rng.normal(0, 1, (4, 6)),
+                    lambda p, a, b: F.pairwise_distance(a, b, p=2,
+                                                        eps=0.0))
+
+
+@case("mod2_cosine_distance")
+def _(rng):
+    _record_module2("cosine_distance", {}, rng.normal(0, 1, (4, 6)),
+                    rng.normal(0, 1, (4, 6)),
+                    lambda p, a, b: F.cosine_similarity(a, b, dim=-1))
+
+
+@case("cosine_layer")
+def _(rng):
+    params = {"weight": rng.normal(0, 0.5, (6, 4))}
+    _record("cosine_layer", params, rng.normal(0, 1, (5, 4)),
+            lambda p, x: F.cosine_similarity(
+                x[:, None, :], p["weight"][None], dim=-1))
+
+
+@case("euclidean_layer")
+def _(rng):
+    params = {"weight": rng.normal(0, 0.5, (6, 4))}
+    _record("euclidean_layer", params, rng.normal(0, 1, (5, 4)),
+            lambda p, x: (x[:, None, :] - p["weight"][None])
+            .pow(2).sum(-1).sqrt())
+
+
+@case("maxout")
+def _(rng):
+    # pool=2, output=3: weight rows grouped (pool, out)
+    params = {"weight": rng.normal(0, 0.3, (6, 4)),
+              "bias": rng.normal(0, 0.1, (6,))}
+
+    def fwd(p, x):
+        y = F.linear(x, p["weight"], p["bias"])
+        return y.reshape(x.shape[0], 2, 3).max(dim=1).values
+    _record("maxout", params, rng.normal(0, 1, (5, 4)), fwd)
+
+
+@case("highway")
+def _(rng):
+    params = {"weight": rng.normal(0, 0.3, (5, 5)),
+              "bias": rng.normal(0, 0.1, (5,)),
+              "gate_weight": rng.normal(0, 0.3, (5, 5)),
+              "gate_bias": rng.normal(0, 0.1, (5,))}
+
+    def fwd(p, x):
+        t = torch.sigmoid(F.linear(x, p["gate_weight"], p["gate_bias"]))
+        h = torch.tanh(F.linear(x, p["weight"], p["bias"]))
+        return t * h + (1.0 - t) * x
+    _record("highway", params, rng.normal(0, 1, (4, 5)), fwd)
+
+
+@case("add_layer")
+def _(rng):
+    params = {"bias": rng.normal(0, 0.5, (6,))}
+    _record("add_layer", params, rng.normal(0, 1, (4, 6)),
+            lambda p, x: x + p["bias"])
+
+
+@case("mul_layer")
+def _(rng):
+    params = {"weight": np.asarray(1.7)}
+    _record("mul_layer", params, rng.normal(0, 1, (4, 6)),
+            lambda p, x: x * p["weight"])
+
+
+@case("cmul")
+def _(rng):
+    params = {"weight": rng.normal(0, 0.5, (1, 6))}
+    _record("cmul", params, rng.normal(0, 1, (4, 6)),
+            lambda p, x: x * p["weight"])
+
+
+@case("cadd")
+def _(rng):
+    params = {"bias": rng.normal(0, 0.5, (1, 6))}
+    _record("cadd", params, rng.normal(0, 1, (4, 6)),
+            lambda p, x: x + p["bias"])
+
+
+@case("power")
+def _(rng):
+    _record("power", {}, rng.uniform(0.1, 2.0, (4, 6)),
+            lambda p, x: (2.0 * x + 1.0).pow(1.5))
+
+
+@case("clamp")
+def _(rng):
+    _record("clamp", {}, rng.normal(0, 2, (4, 6)),
+            lambda p, x: x.clamp(-0.5, 0.8))
+
+
 if __name__ == "__main__":
     main(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -97,6 +97,20 @@ MODULES.update({
     "act_softmin": lambda: nn.SoftMin(),
 })
 
+# round-3b: tensor-math layer family (nn/tensor_extras.py)
+MODULES.update({
+    "cosine_layer": lambda: nn.Cosine(4, 6),
+    "euclidean_layer": lambda: nn.Euclidean(4, 6),
+    "maxout": lambda: nn.Maxout(4, 3, 2),
+    "highway": lambda: nn.Highway(5),
+    "add_layer": lambda: nn.Add(6),
+    "mul_layer": lambda: nn.Mul(),
+    "cmul": lambda: nn.CMul((1, 6)),
+    "cadd": lambda: nn.CAdd((1, 6)),
+    "power": lambda: nn.Power(1.5, 2.0, 1.0),
+    "clamp": lambda: nn.Clamp(-0.5, 0.8),
+})
+
 TOL = dict(rtol=2e-4, atol=2e-5)
 
 
@@ -210,6 +224,45 @@ def test_criterion_fixture_parity(name):
     dx = jax.grad(lambda xx: crit.apply(xx, t))(x)
     np.testing.assert_allclose(np.asarray(dx), z["dx"], **TOL,
                                err_msg=f"{name}: grad mismatch")
+
+
+# ------------------------------------------------ pair-input modules
+MODULES2 = {
+    "bilinear": lambda: nn.Bilinear(3, 4, 5),
+    "mm": lambda: nn.MM(),
+    "dot_product": lambda: nn.DotProduct(),
+    "pairwise_distance": lambda: nn.PairwiseDistance(norm=2),
+    "cosine_distance": lambda: nn.CosineDistance(),
+}
+
+
+@pytest.mark.parametrize("name", sorted(MODULES2))
+def test_pair_module_fixture_parity(name):
+    path = os.path.join(DATA_DIR, f"mod2_{name}.npz")
+    if not os.path.exists(path):
+        pytest.skip("fixture not generated")
+    z = np.load(path)
+    mod = MODULES2[name]()
+    params = {k[2:]: jnp.asarray(z[k], jnp.float32)
+              for k in z.files if k.startswith("p_")}
+    x1 = jnp.asarray(z["x1"], jnp.float32)
+    x2 = jnp.asarray(z["x2"], jnp.float32)
+    out, _ = mod.apply(params, {}, (x1, x2))
+    np.testing.assert_allclose(np.asarray(out), z["out"], **TOL,
+                               err_msg=f"{name}: forward mismatch")
+
+    def loss(p, a, b):
+        y, _ = mod.apply(p, {}, (a, b))
+        return jnp.sum(y)
+
+    dp, d1, d2 = jax.grad(loss, argnums=(0, 1, 2))(params, x1, x2)
+    np.testing.assert_allclose(np.asarray(d1), z["dx1"], **TOL,
+                               err_msg=f"{name}: grad x1 mismatch")
+    np.testing.assert_allclose(np.asarray(d2), z["dx2"], **TOL,
+                               err_msg=f"{name}: grad x2 mismatch")
+    for k in params:
+        np.testing.assert_allclose(np.asarray(dp[k]), z[f"dp_{k}"], **TOL,
+                                   err_msg=f"{name}: grad_{k} mismatch")
 
 
 # ---------------------------------------------- pair-input criterions

@@ -161,6 +161,28 @@ class TestPerLayerRegularizers:
         # strong L2 on layer 0 must shrink its weights vs no regularizer
         assert train(nn.L2Regularizer(1.0)) < 0.5 * train(None)
 
+    def test_bigdl_checkpoint_persists_regularizers(self, tmp_path):
+        """r3 review: save/load must not silently drop the penalties
+        (reference ModuleSerializer persists wRegularizer/bRegularizer)."""
+        from bigdl_tpu.interop import save_bigdl_module, load_bigdl_module
+        from bigdl_tpu.nn.regularizers import has_regularizers
+        m = nn.Sequential(
+            nn.Linear(4, 3, w_regularizer=nn.L2Regularizer(0.25),
+                      b_regularizer=nn.L1Regularizer(0.125)),
+            nn.SpatialConvolution(1, 1, 1, 1,
+                                  w_regularizer=nn.L1L2Regularizer(
+                                      0.5, 0.75)))
+        m.initialize(0)
+        path = str(tmp_path / "reg.bigdl")
+        save_bigdl_module(m, path)
+        m2 = load_bigdl_module(path)
+        assert has_regularizers(m2)
+        lin, conv = m2.modules
+        assert (lin.w_regularizer.l1, lin.w_regularizer.l2) == (0.0, 0.25)
+        assert (lin.b_regularizer.l1, lin.b_regularizer.l2) == (0.125, 0.0)
+        assert (conv.w_regularizer.l1,
+                conv.w_regularizer.l2) == (0.5, 0.75)
+
 
 class TestPaddingBuckets:
     def test_bucketed_padding_bounds_compiles(self):

@@ -109,6 +109,7 @@ def test_imageclassification_recipe():
 def test_mlpipeline_recipe():
     out = _run("examples/mlpipeline/train_classifier.py", "-e", "15")
     assert _final_metric(out, "train_acc") > 0.9, out
+    assert _final_metric(out, "lenet_acc") > 0.9, out
     assert _final_metric(out, "mse") < 0.01, out
 
 
