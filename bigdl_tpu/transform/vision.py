@@ -111,6 +111,39 @@ class ImageFrame:
                  for i, img in enumerate(images)]
         return LocalImageFrame(feats)
 
+    @staticmethod
+    def read(path: str, with_label: bool = False) -> "LocalImageFrame":
+        """Read a directory of images into a Local frame (reference
+        ``ImageFrame.read`` / ``DLImageReader``).  ``with_label=True``
+        uses the ImageNet folder convention — one subdirectory per
+        class, labels assigned by sorted subdirectory order."""
+        import os
+        from PIL import Image
+
+        exts = (".jpg", ".jpeg", ".png", ".bmp")
+
+        def load(p):
+            return np.asarray(Image.open(p).convert("RGB"), np.float32)
+
+        feats: List[ImageFeature] = []
+        if with_label:
+            classes = sorted(d for d in os.listdir(path)
+                             if os.path.isdir(os.path.join(path, d)))
+            for label, cls in enumerate(classes):
+                cdir = os.path.join(path, cls)
+                for fn in sorted(os.listdir(cdir)):
+                    if fn.lower().endswith(exts):
+                        feats.append(ImageFeature(
+                            load(os.path.join(cdir, fn)),
+                            label=np.int32(label),
+                            uri=os.path.join(cls, fn)))
+        else:
+            for fn in sorted(os.listdir(path)):
+                if fn.lower().endswith(exts):
+                    feats.append(ImageFeature(
+                        load(os.path.join(path, fn)), uri=fn))
+        return LocalImageFrame(feats)
+
 
 class LocalImageFrame(ImageFrame):
     def __init__(self, features: List[ImageFeature]):

@@ -220,3 +220,34 @@ class TestPaddingBuckets:
                    Sample(np.zeros((2, 2), np.float32), np.int32(0))]
         with pytest.raises(ValueError, match="bucket"):
             batch_samples(samples, feature_padding=param)
+
+
+class TestImageFrameRead:
+    """Reference ``ImageFrame.read`` / ``DLImageReader``: folder →
+    LocalImageFrame, with the one-subdir-per-class label convention."""
+
+    def _write_imgs(self, root, layout):
+        from PIL import Image
+        rng = np.random.RandomState(0)
+        for rel in layout:
+            p = os.path.join(str(root), rel)
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            Image.fromarray(rng.randint(0, 255, (6, 8, 3), dtype=np.uint8)
+                            ).save(p)
+
+    def test_flat_folder(self, tmp_path):
+        from bigdl_tpu.transform.vision import ImageFrame
+        self._write_imgs(tmp_path, ["b.png", "a.jpg"])
+        open(str(tmp_path / "readme.txt"), "w").write("not an image")
+        frame = ImageFrame.read(str(tmp_path))
+        assert [f["uri"] for f in frame.features] == ["a.jpg", "b.png"]
+        assert frame.features[0].image.shape == (6, 8, 3)
+
+    def test_labeled_subdirs(self, tmp_path):
+        from bigdl_tpu.transform.vision import ImageFrame
+        self._write_imgs(tmp_path, ["cat/x.png", "cat/y.png", "dog/z.png"])
+        frame = ImageFrame.read(str(tmp_path), with_label=True)
+        labels = [int(f.label) for f in frame.features]
+        assert labels == [0, 0, 1]
+        samples = frame.to_samples()
+        assert samples[2].label == 1
