@@ -192,24 +192,36 @@ class GRU(Cell):
 
 class ConvLSTMPeephole(Cell):
     """Convolutional LSTM over NCHW feature maps (reference
-    ``ConvLSTMPeephole.scala``)."""
+    ``ConvLSTMPeephole.scala``).
+
+    ``with_peephole=True`` adds the reference's per-channel peephole
+    terms (Wci/Wcf/Wco elementwise on the cell state, the reference
+    DEFAULT); ``False`` is the plain ConvLSTM variant (its
+    ``withPeephole=false`` mode), kept as this class's default for
+    backward compatibility with earlier rounds' checkpoints."""
 
     def __init__(self, input_size: int, output_size: int, kernel: int = 3,
                  spatial: Optional[tuple[int, int]] = None,
+                 with_peephole: bool = False,
                  name: Optional[str] = None):
         super().__init__(name)
         self.input_size, self.output_size = input_size, output_size
         self.kernel = kernel
         self.spatial = spatial  # (H, W), required for initial_hidden
         self.hidden_size = output_size
+        self.with_peephole = with_peephole
 
     def init(self, rng):
-        k1, k2 = jax.random.split(rng)
+        k1, k2, k3 = jax.random.split(rng, 3)
         C_in, C_out, K = self.input_size, self.output_size, self.kernel
         fan = (C_in + C_out) * K * K
         w = _uniform(k1, (4 * C_out, C_in + C_out, K, K), fan)
         b = _uniform(k2, (4 * C_out,), fan)
-        return {"weight": w, "bias": b}, {}
+        params = {"weight": w, "bias": b}
+        if self.with_peephole:
+            # per-channel Wci/Wcf/Wco (reference peephole CMuls)
+            params["peep"] = _uniform(k3, (3, C_out), fan)
+        return params, {}
 
     def initial_hidden(self, batch_size: int):
         assert self.spatial is not None, \
@@ -226,7 +238,13 @@ class ConvLSTMPeephole(Cell):
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
         z = z + params["bias"][None, :, None, None]
         i, f, g, o = jnp.split(z, 4, axis=1)
+        if self.with_peephole:
+            p = params["peep"][:, None, :, None, None]
+            i = i + p[0] * c
+            f = f + p[1] * c
         c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        if self.with_peephole:
+            o = o + params["peep"][2][None, :, None, None] * c_new
         h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
         return h_new, (h_new, c_new)
 

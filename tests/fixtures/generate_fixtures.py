@@ -1134,5 +1134,94 @@ def _(rng):
             lambda p, x: x.clamp(-0.5, 0.8))
 
 
+@case("bi_recurrent_lstm")
+def _(rng):
+    """BiRecurrent(LSTM): forward + time-reversed backward pass, outputs
+    concatenated on features."""
+    N, T, D, H = 2, 4, 3, 5
+    x = rng.normal(0, 1, (N, T, D))
+
+    def lstm(p, x_seq):
+        h = torch.zeros(N, H, dtype=torch.float64)
+        c = torch.zeros(N, H, dtype=torch.float64)
+        ys = []
+        for t in range(x_seq.shape[1]):
+            z = F.linear(torch.cat([x_seq[:, t], h], dim=1),
+                         p["weight"], p["bias"])
+            i, f, g, o = z.chunk(4, dim=1)
+            i, f, o = torch.sigmoid(i), torch.sigmoid(f), torch.sigmoid(o)
+            c = f * c + i * torch.tanh(g)
+            h = o * torch.tanh(c)
+            ys.append(h)
+        return torch.stack(ys, dim=1)
+
+    def fwd(p, x):
+        yf = lstm({"weight": p["fwd_weight"], "bias": p["fwd_bias"]}, x)
+        yb = lstm({"weight": p["bwd_weight"], "bias": p["bwd_bias"]},
+                  torch.flip(x, dims=(1,)))
+        yb = torch.flip(yb, dims=(1,))
+        return torch.cat([yf, yb], dim=-1)
+
+    flat = {"fwd_weight": rng.normal(0, 0.3, (4 * H, D + H)),
+            "fwd_bias": rng.normal(0, 0.1, (4 * H,)),
+            "bwd_weight": rng.normal(0, 0.3, (4 * H, D + H)),
+            "bwd_bias": rng.normal(0, 0.1, (4 * H,))}
+    _record("bi_recurrent_lstm", flat, x, fwd)
+
+
+@case("conv_lstm_peephole")
+def _(rng):
+    """ConvLSTM (withPeephole=false mode): per-step SAME conv over
+    [x, h] channels, i,f,g,o gate maps."""
+    N, T, Ci, Co, K, S = 2, 3, 2, 4, 3, 5
+    x = rng.normal(0, 1, (N, T, Ci, S, S))
+    params = {"weight": rng.normal(0, 0.2, (4 * Co, Ci + Co, K, K)),
+              "bias": rng.normal(0, 0.1, (4 * Co,))}
+
+    def fwd(p, x):
+        h = torch.zeros(N, Co, S, S, dtype=torch.float64)
+        c = torch.zeros(N, Co, S, S, dtype=torch.float64)
+        ys = []
+        for t in range(T):
+            z = F.conv2d(torch.cat([x[:, t], h], dim=1), p["weight"],
+                         p["bias"], padding=K // 2)
+            i, f, g, o = z.chunk(4, dim=1)
+            c = torch.sigmoid(f) * c + torch.sigmoid(i) * torch.tanh(g)
+            h = torch.sigmoid(o) * torch.tanh(c)
+            ys.append(h)
+        return torch.stack(ys, dim=1)
+    _record("conv_lstm_peephole", params, x, fwd)
+
+
+@case("conv_lstm_with_peephole")
+def _(rng):
+    """ConvLSTM WITH the reference's per-channel peephole terms
+    (ConvLSTMPeephole.scala withPeephole=true default): Wci/Wcf gate on
+    c, Wco on the new c."""
+    N, T, Ci, Co, K, S = 2, 3, 2, 4, 3, 5
+    x = rng.normal(0, 1, (N, T, Ci, S, S))
+    params = {"weight": rng.normal(0, 0.2, (4 * Co, Ci + Co, K, K)),
+              "bias": rng.normal(0, 0.1, (4 * Co,)),
+              "peep": rng.normal(0, 0.2, (3, Co))}
+
+    def fwd(p, x):
+        h = torch.zeros(N, Co, S, S, dtype=torch.float64)
+        c = torch.zeros(N, Co, S, S, dtype=torch.float64)
+        pe = p["peep"][:, None, :, None, None]
+        ys = []
+        for t in range(T):
+            z = F.conv2d(torch.cat([x[:, t], h], dim=1), p["weight"],
+                         p["bias"], padding=K // 2)
+            i, f, g, o = z.chunk(4, dim=1)
+            i = i + pe[0] * c
+            f = f + pe[1] * c
+            c = torch.sigmoid(f) * c + torch.sigmoid(i) * torch.tanh(g)
+            o = o + pe[2] * c
+            h = torch.sigmoid(o) * torch.tanh(c)
+            ys.append(h)
+        return torch.stack(ys, dim=1)
+    _record("conv_lstm_with_peephole", params, x, fwd)
+
+
 if __name__ == "__main__":
     main(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -97,8 +97,33 @@ MODULES.update({
     "act_softmin": lambda: nn.SoftMin(),
 })
 
+def _bi_recurrent():
+    from bigdl_tpu.nn import recurrent as R
+    return nn.BiRecurrent(R.LSTM(3, 5))
+
+
+# fixtures whose torch-side params are stored FLAT; map to the module's
+# nested tree (and back, for gradient comparison)
+RESTRUCTURE = {
+    "bi_recurrent_lstm": (
+        lambda p: {"fwd": {"weight": p["fwd_weight"],
+                           "bias": p["fwd_bias"]},
+                   "bwd": {"weight": p["bwd_weight"],
+                           "bias": p["bwd_bias"]}},
+        lambda t: {"fwd_weight": t["fwd"]["weight"],
+                   "fwd_bias": t["fwd"]["bias"],
+                   "bwd_weight": t["bwd"]["weight"],
+                   "bwd_bias": t["bwd"]["bias"]}),
+}
+
 # round-3b: tensor-math layer family (nn/tensor_extras.py)
 MODULES.update({
+    "bi_recurrent_lstm": _bi_recurrent,
+    "conv_lstm_peephole": _recurrent(
+        lambda R: R.ConvLSTMPeephole(2, 4, kernel=3, spatial=(5, 5))),
+    "conv_lstm_with_peephole": _recurrent(
+        lambda R: R.ConvLSTMPeephole(2, 4, kernel=3, spatial=(5, 5),
+                                     with_peephole=True)),
     "cosine_layer": lambda: nn.Cosine(4, 6),
     "euclidean_layer": lambda: nn.Euclidean(4, 6),
     "maxout": lambda: nn.Maxout(4, 3, 2),
@@ -132,6 +157,9 @@ def test_fixture_parity(name):
     x, params, state, want_out, want_dx, want_dp, want_ns = _load(name)
     mod = MODULES[name]()
     training = bool(want_ns)  # ns_* entries = training-mode fixture
+    nest_flatten = RESTRUCTURE.get(name)
+    if nest_flatten:
+        params = nest_flatten[0](params)
     jparams = jax.tree_util.tree_map(
         lambda a: jnp.asarray(a, jnp.float32), params)
     jstate = jax.tree_util.tree_map(
@@ -162,6 +190,8 @@ def test_fixture_parity(name):
             np.testing.assert_allclose(np.asarray(dx), want_dx, **TOL,
                                        err_msg=f"{name}: grad_input "
                                                "mismatch")
+    if nest_flatten:
+        dp = nest_flatten[1](dp)
     for k, want in want_dp.items():
         np.testing.assert_allclose(np.asarray(dp[k]), want, **TOL,
                                    err_msg=f"{name}: grad_{k} mismatch")
