@@ -50,6 +50,18 @@ def _dyn_quantize(x: jnp.ndarray):
     return q, scale
 
 
+def _int8_linear(x, wq, wscale, bias=None):
+    """Dynamic-int8 ``x @ W.T + b`` on the MXU int8 path."""
+    xq, xs = _dyn_quantize(x)
+    acc = lax.dot_general(xq, wq.T,
+                          dimension_numbers=(((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (xs * wscale.reshape(-1)[None])
+    if bias is not None:
+        y = y + bias
+    return y
+
+
 class QuantizedLinear(Module):
     """int8 Linear (reference ``quantized/Linear.scala``)."""
 
@@ -70,15 +82,8 @@ class QuantizedLinear(Module):
         return {}, {}
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        xq, xs = _dyn_quantize(input)
-        acc = lax.dot_general(
-            xq, self.weight_q.T,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        y = acc.astype(jnp.float32) * (xs * self.weight_scale[:, 0][None])
-        if self.bias is not None:
-            y = y + self.bias
-        return y, state
+        return _int8_linear(input, self.weight_q, self.weight_scale,
+                            self.bias), state
 
 
 class QuantizedSpatialConvolution(Module):
@@ -130,13 +135,114 @@ class QuantizedSpatialConvolution(Module):
         return y, state
 
 
+# --------------------------------------------------- quantized recurrent
+# (reference Quantization.quantize also converts the recurrent cells —
+# "Linear/SpatialConvolution/gru etc", SURVEY §2.2 quantized row; the
+# cells' fused gate projections are exactly the BigQuant GEMM shape)
+class _QuantizedCellBase(Module):
+    """Module subclass so spec_children tree-walkers (regularizers,
+    sharding specs, exporters) traverse quantized cells like any leaf."""
+
+    def __init__(self, cell):
+        super().__init__(f"Quantized{type(cell).__name__}")
+        self.cell = cell
+        self.hidden_size = cell.hidden_size
+
+    def initial_hidden(self, batch_size):
+        return self.cell.initial_hidden(batch_size)
+
+    def init(self, rng):
+        return {}, {}
+
+
+class QuantizedLSTM(_QuantizedCellBase):
+    """int8 gate projection LSTM cell."""
+
+    def __init__(self, cell, params):
+        super().__init__(cell)
+        self.wq, self.ws = _quantize_symmetric(
+            np.asarray(params["weight"]), axis=1)
+        self.wq = jnp.asarray(self.wq)
+        self.ws = jnp.asarray(self.ws)
+        self.bias = jnp.asarray(params["bias"])
+
+    def step(self, params, x_t, hidden):
+        h, c = hidden
+        z = _int8_linear(jnp.concatenate([x_t, h], axis=-1), self.wq,
+                         self.ws, self.bias)
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + self.cell.forget_bias)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class QuantizedGRU(_QuantizedCellBase):
+    """int8 gate + candidate projections GRU cell (Keras/reference
+    convention: reset applied to h BEFORE the candidate projection)."""
+
+    def __init__(self, cell, params):
+        super().__init__(cell)
+        self.gq, self.gs = _quantize_symmetric(
+            np.asarray(params["w_gates"]), axis=1)
+        self.cq, self.cs = _quantize_symmetric(
+            np.asarray(params["w_cand"]), axis=1)
+        self.gq, self.gs = jnp.asarray(self.gq), jnp.asarray(self.gs)
+        self.cq, self.cs = jnp.asarray(self.cq), jnp.asarray(self.cs)
+        self.b_gates = jnp.asarray(params["b_gates"])
+        self.b_cand = jnp.asarray(params["b_cand"])
+
+    def step(self, params, x_t, h):
+        z = _int8_linear(jnp.concatenate([x_t, h], axis=-1), self.gq,
+                         self.gs, self.b_gates)
+        r, u = jnp.split(jax.nn.sigmoid(z), 2, axis=-1)
+        cand = jnp.tanh(_int8_linear(
+            jnp.concatenate([x_t, r * h], axis=-1), self.cq, self.cs,
+            self.b_cand))
+        h_new = u * h + (1 - u) * cand
+        return h_new, h_new
+
+
+class QuantizedRnnCell(_QuantizedCellBase):
+    """int8 simple RNN cell."""
+
+    def __init__(self, cell, params):
+        super().__init__(cell)
+        w = np.concatenate([np.asarray(params["w_ih"]),
+                            np.asarray(params["w_hh"])], axis=1)
+        self.wq, self.ws = _quantize_symmetric(w, axis=1)
+        self.wq, self.ws = jnp.asarray(self.wq), jnp.asarray(self.ws)
+        self.bias = jnp.asarray(params["bias"])
+
+    def step(self, params, x_t, h):
+        z = _int8_linear(jnp.concatenate([x_t, h], axis=-1), self.wq,
+                         self.ws, self.bias)
+        h_new = self.cell.activation(z)
+        return h_new, h_new
+
+
+def _quantize_cell(cell, params):
+    from bigdl_tpu.nn.recurrent import GRU, LSTM, RnnCell
+    if type(cell) is LSTM:
+        return QuantizedLSTM(cell, params)
+    if type(cell) is GRU:
+        return QuantizedGRU(cell, params)
+    if type(cell) is RnnCell:
+        return QuantizedRnnCell(cell, params)
+    return None
+
+
 def quantize(model: Module) -> Module:
     """Post-training quantization of a materialized (eager) module tree —
     the ``model.quantize()`` entry point (reference
     ``Quantization.quantize``).  Returns a NEW module; the original is
-    untouched.  Linear/SpatialConvolution become int8; everything else is
-    kept (running on f32 activations exactly like the reference's mixed
-    graph)."""
+    untouched.  Linear/SpatialConvolution and the LSTM/GRU/RnnCell gate
+    projections become int8; everything else is kept (running on f32
+    activations exactly like the reference's mixed graph)."""
+    from bigdl_tpu.nn.recurrent import BiRecurrent, Recurrent
     model._ensure_init()
 
     def convert(m: Module, params) -> Module:
@@ -144,6 +250,18 @@ def quantize(model: Module) -> Module:
             out = copy.copy(m)
             out.modules = [convert(c, params.get(str(i), {}))
                            for i, c in enumerate(m.modules)]
+            return out
+        if isinstance(m, Recurrent):
+            qc = _quantize_cell(m.cell, params)
+            if qc is not None:
+                out = copy.copy(m)
+                out.cell = qc
+                return out
+            return m
+        if isinstance(m, BiRecurrent):
+            out = copy.copy(m)
+            out.fwd = convert(m.fwd, params.get("fwd", {}))
+            out.bwd = convert(m.bwd, params.get("bwd", {}))
             return out
         if isinstance(m, Linear):
             return QuantizedLinear.from_linear(m, params)
@@ -165,6 +283,13 @@ def quantize(model: Module) -> Module:
                                  state.get(str(i), {}))
                 p[str(i)], s[str(i)] = cp, cs
             return p, s
+        if isinstance(m, Recurrent) \
+                and isinstance(m.cell, _QuantizedCellBase):
+            return {}, {}
+        if isinstance(m, BiRecurrent):
+            pf, _ = rebuild(m.fwd, params.get("fwd", {}), {})
+            pb, _ = rebuild(m.bwd, params.get("bwd", {}), {})
+            return {"fwd": pf, "bwd": pb}, state
         if isinstance(m, (QuantizedLinear, QuantizedSpatialConvolution)):
             return {}, {}
         return params, state

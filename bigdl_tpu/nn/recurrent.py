@@ -212,7 +212,12 @@ class ConvLSTMPeephole(Cell):
         self.with_peephole = with_peephole
 
     def init(self, rng):
-        k1, k2, k3 = jax.random.split(rng, 3)
+        # split(2) when peephole-free so earlier rounds' seeded init
+        # streams are preserved exactly
+        if self.with_peephole:
+            k1, k2, k3 = jax.random.split(rng, 3)
+        else:
+            k1, k2 = jax.random.split(rng)
         C_in, C_out, K = self.input_size, self.output_size, self.kernel
         fan = (C_in + C_out) * K * K
         w = _uniform(k1, (4 * C_out, C_in + C_out, K, K), fan)

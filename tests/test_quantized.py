@@ -91,3 +91,56 @@ def test_int32_accumulation_exact():
     x = np.array([[127.0, -127.0]], np.float32)
     out = np.asarray(q.forward(x))
     np.testing.assert_allclose(out, x @ w.T, rtol=1e-6)
+
+
+# --------------------------------------------------- recurrent (r3)
+# reference Quantization.quantize also converts recurrent cells
+# ("Linear/SpatialConvolution/gru etc", SURVEY §2.2)
+def test_quantized_lstm_close_to_f32():
+    from bigdl_tpu.nn.quantized import QuantizedLSTM, quantize
+    from bigdl_tpu.nn.recurrent import LSTM, Recurrent
+    rng = np.random.RandomState(0)
+    model = nn.Sequential(Recurrent(LSTM(6, 8)))
+    model.initialize(0)
+    x = jnp.asarray(rng.rand(3, 7, 6).astype(np.float32))
+    ref = np.asarray(model.forward(x))
+    q = quantize(model)
+    assert isinstance(q.modules[0].cell, QuantizedLSTM)
+    out = np.asarray(q.forward(x))
+    assert out.shape == ref.shape
+    # int8 gates: small relative error, same dynamics
+    assert np.max(np.abs(out - ref)) < 0.06, np.max(np.abs(out - ref))
+
+
+def test_quantized_gru_and_rnn_cells():
+    from bigdl_tpu.nn.quantized import (QuantizedGRU, QuantizedRnnCell,
+                                        quantize)
+    from bigdl_tpu.nn.recurrent import GRU, Recurrent, RnnCell
+    rng = np.random.RandomState(1)
+    for cell, qcls in ((GRU(5, 6), QuantizedGRU),
+                       (RnnCell(5, 6), QuantizedRnnCell)):
+        model = nn.Sequential(Recurrent(cell))
+        model.initialize(2)
+        x = jnp.asarray(rng.rand(2, 5, 5).astype(np.float32))
+        ref = np.asarray(model.forward(x))
+        q = quantize(model)
+        assert isinstance(q.modules[0].cell, qcls)
+        out = np.asarray(q.forward(x))
+        assert np.max(np.abs(out - ref)) < 0.08, np.max(np.abs(out - ref))
+
+
+def test_quantized_bi_recurrent():
+    from bigdl_tpu.nn.quantized import QuantizedLSTM, quantize
+    from bigdl_tpu.nn.recurrent import LSTM
+    rng = np.random.RandomState(2)
+    model = nn.Sequential(nn.BiRecurrent(LSTM(4, 5)))
+    model.initialize(3)
+    x = jnp.asarray(rng.rand(2, 6, 4).astype(np.float32))
+    ref = np.asarray(model.forward(x))
+    q = quantize(model)
+    bi = q.modules[0]
+    assert isinstance(bi.fwd.cell, QuantizedLSTM)
+    assert isinstance(bi.bwd.cell, QuantizedLSTM)
+    out = jax.jit(lambda xx: q.apply(q._params, q._state, xx,
+                                     training=False)[0])(x)
+    assert np.max(np.abs(np.asarray(out) - ref)) < 0.08
