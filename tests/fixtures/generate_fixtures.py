@@ -1134,6 +1134,51 @@ def _(rng):
             lambda p, x: x.clamp(-0.5, 0.8))
 
 
+@case("layer_norm")
+def _(rng):
+    params = {"weight": rng.uniform(0.5, 1.5, (8,)),
+              "bias": rng.normal(0, 0.2, (8,))}
+    _record("layer_norm", params, rng.normal(0, 2, (3, 5, 8)),
+            lambda p, x: F.layer_norm(x, (8,), p["weight"], p["bias"],
+                                      eps=1e-5))
+
+
+def _mha_fixture(name, causal, rng):
+    """torch.nn.functional.multi_head_attention_forward is the
+    INDEPENDENT oracle; our (in, out)-layout weights map to torch's
+    (out, in) in_proj/out_proj via transposes."""
+    N, T, D, H = 2, 5, 8, 2
+    x = rng.normal(0, 1, (N, T, D))
+    params = {k: rng.normal(0, 0.3, (D, D)) for k in
+              ("wq", "wk", "wv", "wo")}
+    params.update({k: rng.normal(0, 0.1, (D,)) for k in
+                   ("bq", "bk", "bv", "bo")})
+
+    def fwd(p, x):
+        in_w = torch.cat([p["wq"].T, p["wk"].T, p["wv"].T], dim=0)
+        in_b = torch.cat([p["bq"], p["bk"], p["bv"]])
+        mask = None
+        if causal:
+            mask = torch.triu(torch.full((T, T), float("-inf"),
+                                         dtype=torch.float64), diagonal=1)
+        xt = x.transpose(0, 1)  # (T, N, D)
+        out, _ = F.multi_head_attention_forward(
+            xt, xt, xt, D, H, in_w, in_b, None, None, False, 0.0,
+            p["wo"].T, p["bo"], need_weights=False, attn_mask=mask)
+        return out.transpose(0, 1)
+    _record(name, params, x, fwd)
+
+
+@case("multi_head_attention")
+def _(rng):
+    _mha_fixture("multi_head_attention", False, rng)
+
+
+@case("multi_head_attention_causal")
+def _(rng):
+    _mha_fixture("multi_head_attention_causal", True, rng)
+
+
 @case("bi_recurrent_lstm")
 def _(rng):
     """BiRecurrent(LSTM): forward + time-reversed backward pass, outputs

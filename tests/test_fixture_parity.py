@@ -118,6 +118,10 @@ RESTRUCTURE = {
 
 # round-3b: tensor-math layer family (nn/tensor_extras.py)
 MODULES.update({
+    "layer_norm": lambda: nn.LayerNorm(8),
+    "multi_head_attention": lambda: nn.MultiHeadAttention(8, 2),
+    "multi_head_attention_causal":
+        lambda: nn.MultiHeadAttention(8, 2, causal=True),
     "bi_recurrent_lstm": _bi_recurrent,
     "conv_lstm_peephole": _recurrent(
         lambda R: R.ConvLSTMPeephole(2, 4, kernel=3, spatial=(5, 5))),
