@@ -684,6 +684,39 @@ def _decode_png(attrs, contents):
     return _decode_image(attrs, contents)
 
 
+@register_op("DecodeImage")
+def _decode_any_image(attrs, contents):
+    """Format-sniffing decode (TF DecodeImage); PIL sniffs the container
+    itself.  GIF payloads come back (frames, H, W, C) like TF unless
+    ``expand_animations=False`` (first frame, rank 3).  ``dtype``
+    converts like TF's convert_image_dtype (uint8 ints, [0,1] floats)."""
+    data = _to_bytes_list(contents)[0]
+    if data[:6] in (b"GIF87a", b"GIF89a"):
+        out = _decode_gif(attrs, data)
+        if not bool(attrs.get("expand_animations", True)):
+            out = out[0]
+    else:
+        out = _decode_image(attrs, data)
+    dt = int(attrs.get("dtype", 4))  # DT_UINT8=4
+    if dt in (1, 2, 19):             # float32/float64/half → [0, 1]
+        out = (out.astype({1: np.float32, 2: np.float64,
+                           19: np.float16}[dt]) / 255.0)
+    elif dt != 4:
+        raise NotImplementedError(f"DecodeImage dtype {dt}")
+    return out
+
+
+@register_op("DecodeGif")
+def _decode_gif(attrs, contents):
+    """All frames, (num_frames, H, W, 3) uint8 (TF DecodeGif)."""
+    from PIL import Image, ImageSequence
+    import io
+    img = Image.open(io.BytesIO(_to_bytes_list(contents)[0]))
+    frames = [np.asarray(f.convert("RGB"), np.uint8)
+              for f in ImageSequence.Iterator(img)]
+    return np.stack(frames)
+
+
 @register_op("ParseExample")
 def _parse_example(attrs, serialized, names, *keys_and_defaults):
     """Dense-feature subset of TF's ParseExample (reference
