@@ -43,6 +43,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 
 def _attr_frame(node) -> Optional[str]:
     f = node["attrs"].get("frame_name")
@@ -299,7 +301,6 @@ def static_trip_count(frame, by_name, const_eval) -> Optional[int]:
     limit = const_eval(rhs.split(":")[0])
     if merge_nm is None or limit is None:
         return None
-    limit = float(limit)
     # counter init: the merge's Enter input's outer value
     merge_ix = {m["name"]: i for i, m in enumerate(frame.merges)}
     ix = merge_ix[merge_nm]
@@ -307,7 +308,6 @@ def static_trip_count(frame, by_name, const_eval) -> Optional[int]:
     init = const_eval(enter["inputs"][0].split(":")[0])
     if init is None:
         return None
-    init = float(init)
     # counter update: NextIteration input must be Add(counter, const)
     merge = frame.merges[ix]
     ni_nm = None
@@ -333,20 +333,33 @@ def static_trip_count(frame, by_name, const_eval) -> Optional[int]:
         step = const_eval(b)
     if step is None:
         return None
-    step = float(step)
+    # exact integer arithmetic when the counter is integral (int64
+    # counters above 2^53 would round under float ceil/floor and the
+    # scan rewrite would silently run a wrong-length loop); float
+    # counters fall back to ceil/floor
+    integral = all(np.asarray(v).dtype.kind in "iu"
+                   for v in (init, limit, step))
+    if integral:
+        init, limit, step = int(init), int(limit), int(step)
+    else:
+        init, limit, step = float(init), float(limit), float(step)
     if add["op"] == "Sub":
         step = -step
     if step == 0:
         return None
     op = cmp_node["op"]
     if op == "Less" and step > 0:
-        n = math.ceil((limit - init) / step)
+        n = (limit - init + step - 1) // step if integral \
+            else math.ceil((limit - init) / step)
     elif op == "LessEqual" and step > 0:
-        n = math.floor((limit - init) / step) + 1
+        n = (limit - init) // step + 1 if integral \
+            else math.floor((limit - init) / step) + 1
     elif op == "Greater" and step < 0:
-        n = math.ceil((limit - init) / step)
+        n = (init - limit - step - 1) // (-step) if integral \
+            else math.ceil((limit - init) / step)
     elif op == "GreaterEqual" and step < 0:
-        n = math.floor((limit - init) / step) + 1
+        n = (init - limit) // (-step) + 1 if integral \
+            else math.floor((limit - init) / step) + 1
     else:
         return None
     return max(int(n), 0)

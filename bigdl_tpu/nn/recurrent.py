@@ -195,14 +195,14 @@ class ConvLSTMPeephole(Cell):
     ``ConvLSTMPeephole.scala``).
 
     ``with_peephole=True`` adds the reference's per-channel peephole
-    terms (Wci/Wcf/Wco elementwise on the cell state, the reference
-    DEFAULT); ``False`` is the plain ConvLSTM variant (its
-    ``withPeephole=false`` mode), kept as this class's default for
-    backward compatibility with earlier rounds' checkpoints."""
+    terms (Wci/Wcf/Wco elementwise on the cell state) and is the
+    default, matching the reference's ``withPeephole=true``;
+    ``False`` is the plain ConvLSTM variant (its
+    ``withPeephole=false`` mode)."""
 
     def __init__(self, input_size: int, output_size: int, kernel: int = 3,
                  spatial: Optional[tuple[int, int]] = None,
-                 with_peephole: bool = False,
+                 with_peephole: bool = True,
                  name: Optional[str] = None):
         super().__init__(name)
         self.input_size, self.output_size = input_size, output_size
@@ -236,6 +236,12 @@ class ConvLSTMPeephole(Cell):
         return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
 
     def step(self, params, x_t, hidden):
+        if self.with_peephole and "peep" not in params:
+            raise KeyError(
+                "ConvLSTMPeephole now defaults to with_peephole=True "
+                "(the reference default); these params have no 'peep' "
+                "entry — construct with with_peephole=False to restore "
+                "a peephole-free checkpoint")
         h, c = hidden
         z = lax.conv_general_dilated(
             jnp.concatenate([x_t, h], axis=1), params["weight"],
@@ -256,24 +262,33 @@ class ConvLSTMPeephole(Cell):
 
 class ConvLSTMPeephole3D(Cell):
     """Volumetric ConvLSTM over NCDHW feature maps (reference
-    ``ConvLSTMPeephole3D.scala``; 3-D twin of :class:`ConvLSTMPeephole`)."""
+    ``ConvLSTMPeephole3D.scala``; 3-D twin of :class:`ConvLSTMPeephole`,
+    including the ``withPeephole=true`` reference default)."""
 
     def __init__(self, input_size: int, output_size: int, kernel: int = 3,
                  spatial: Optional[tuple[int, int, int]] = None,
+                 with_peephole: bool = True,
                  name: Optional[str] = None):
         super().__init__(name)
         self.input_size, self.output_size = input_size, output_size
         self.kernel = kernel
         self.spatial = spatial  # (D, H, W), required for initial_hidden
         self.hidden_size = output_size
+        self.with_peephole = with_peephole
 
     def init(self, rng):
-        k1, k2 = jax.random.split(rng)
+        if self.with_peephole:
+            k1, k2, k3 = jax.random.split(rng, 3)
+        else:
+            k1, k2 = jax.random.split(rng)
         C_in, C_out, K = self.input_size, self.output_size, self.kernel
         fan = (C_in + C_out) * K * K * K
         w = _uniform(k1, (4 * C_out, C_in + C_out, K, K, K), fan)
         b = _uniform(k2, (4 * C_out,), fan)
-        return {"weight": w, "bias": b}, {}
+        params = {"weight": w, "bias": b}
+        if self.with_peephole:
+            params["peep"] = _uniform(k3, (3, C_out), fan)
+        return params, {}
 
     def initial_hidden(self, batch_size: int):
         assert self.spatial is not None, \
@@ -290,7 +305,13 @@ class ConvLSTMPeephole3D(Cell):
             dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
         z = z + params["bias"][None, :, None, None, None]
         i, f, g, o = jnp.split(z, 4, axis=1)
+        if self.with_peephole:
+            p = params["peep"][:, None, :, None, None, None]
+            i = i + p[0] * c
+            f = f + p[1] * c
         c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        if self.with_peephole:
+            o = o + params["peep"][2][None, :, None, None, None] * c_new
         h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
         return h_new, (h_new, c_new)
 

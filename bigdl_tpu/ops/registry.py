@@ -484,7 +484,9 @@ def _in_top_k(attrs, predictions, targets, *k):
         predictions, jnp.asarray(targets).astype(jnp.int32)[:, None],
         axis=1)
     higher = jnp.sum(predictions > tgt, axis=1)
-    return higher < kk
+    # TF returns False ("cannot say") when ANY prediction in the row
+    # is non-finite, not just the target's
+    return (higher < kk) & jnp.all(jnp.isfinite(predictions), axis=1)
 
 
 @register_op("Split")

@@ -162,7 +162,8 @@ class GPipe(Module):
                 # one-hot contribution = distributed queue pop for rank 0
                 owner = t // chunk
                 local_ix = jnp.clip(t - rank * chunk, 0, chunk - 1)
-                mine = jnp.where(rank == owner, xs_local[local_ix], 0.0)
+                mine = jnp.where(rank == owner, xs_local[local_ix],
+                                 jnp.zeros_like(xs_local[local_ix]))
                 feed = lax.psum(mine, axis)
                 x_in = jnp.where(rank == 0, feed, buf)
                 y, st_new = stage_apply(p, st, x_in, training=training)

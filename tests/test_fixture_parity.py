@@ -124,7 +124,8 @@ MODULES.update({
         lambda: nn.MultiHeadAttention(8, 2, causal=True),
     "bi_recurrent_lstm": _bi_recurrent,
     "conv_lstm_peephole": _recurrent(
-        lambda R: R.ConvLSTMPeephole(2, 4, kernel=3, spatial=(5, 5))),
+        lambda R: R.ConvLSTMPeephole(2, 4, kernel=3, spatial=(5, 5),
+                                     with_peephole=False)),
     "conv_lstm_with_peephole": _recurrent(
         lambda R: R.ConvLSTMPeephole(2, 4, kernel=3, spatial=(5, 5),
                                      with_peephole=True)),
