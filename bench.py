@@ -9,36 +9,60 @@ update) on the local TPU chip: images/sec/chip.
 Config: NHWC, bf16 compute / f32 master params, batch 256, donated
 buffers — best of the layout×batch×remat sweep on v5e (see git
 history; batch 512 regresses ~6% past its own bandwidth floor from
-memory pressure, per-block remat costs ~20% because recomputed convs
-re-read activations).
+memory pressure, FULL per-block remat costs ~20% because recomputed
+convs re-read activations — the "tails" variant that saves conv
+outputs and recomputes only BN/ReLU is selected per measurement).
+
+Variance discipline (round-4): the reported value is the MEDIAN over
+``windows`` independent timing windows (fresh compile excluded), with
+the min/max/relative spread attached, so a ±3% wobble can be told from
+a real regression.  Round-3's best-of-4 could not.
 
 ``bottleneck`` is TRACE-BACKED, not asserted: XLA's compiled-executable
 cost analysis (flops + bytes accessed) gives the MXU-time and HBM-time
-floors; the measured step time is compared against both.  On v5e the
-ResNet-50 step's HBM floor is ~3.1x its MXU floor and the measured step
-runs at ~95% of the modeled HBM bandwidth — the model is
-bandwidth-bound, so MFU plateaus near 0.16 by roofline, not by waste.
-(The r2 "batch 256 slower than 128" anomaly did not reproduce under
-longer windows: b256 is slightly faster, see git history.)
+floors; the measured step time is compared against both — for BOTH
+models since round 4.
 
-Anchors:
-- ``vs_baseline`` stays ratioed against the round-1 recorded measurement
-  (1945.9 img/s, ResNet-50) so rounds are comparable.
-- ``mfu`` uses the XLA-counted flops of the compiled step (not a paper
-  constant) over the 197 TFLOP/s v5e bf16 peak.  XLA counts 2 flops per
-  MAC — the same convention as the 197 TFLOP/s spec — so this MFU is
-  ~2x the r2 number, which divided MAC-based model flops by the
-  2-flops/MAC peak (an apples-to-oranges ratio that UNDERstated MFU).
+``mfu`` uses the XLA-counted flops of the compiled step (not a paper
+constant) over the 197 TFLOP/s v5e bf16 peak.  XLA counts 2 flops per
+MAC — the same convention as the 197 TFLOP/s spec.
 
-``--scaling`` mode: runs the DistriOptimizer SPMD step on 1..N virtual CPU
-devices and reports parallel efficiency (reference scaling-claim analog,
-``docs/docs/whitepaper.md:160-164``).  Run separately; the default mode is
-what the driver records.
+``scaling_efficiency`` (round-4, always emitted): fixed-global-batch
+SPMD partitioning overhead on a 1-vs-8 virtual CPU mesh (the only
+standing proxy this single-chip environment can produce for the
+BASELINE ">60% efficiency 1→32 chips" claim; reference
+``docs/docs/whitepaper.md:160-164``).  Gate: ≥0.6 at 8 devices.
+
+Round-4 experiment log (all medians over ≥5 windows, v5e, batch 256;
+baseline ResNet-50 2499.7 img/s / 78.7 GB/step, Inception-v1 4645 /
+37.3 GB/step):
+- remat="tails" (save conv outputs, recompute BN/ReLU): 2160 img/s,
+  bytes 92.5 GB — XLA's own saved-residual choice already beats the
+  forced policy, and checkpoint boundaries block cross-block fusion.
+- full per-block remat: ~20% slower (r3).
+- batch 384: 2442 img/s, floor-fraction drops 0.94→0.84 (memory
+  pressure); batch 512 worse still (r2).
+- bf16 stochastic-rounded momentum: 2443 img/s, bytes 79.5 GB —
+  optimizer state is 0.26% of step traffic; the SR noise costs more
+  than it saves.  Kept as a memory-capacity option (SGD state_dtype).
+- maxpool backward (select-and-scatter) replacements: ablations show
+  S&S wastes ~8.6 ms/step on Inception (pool-stubbed model runs at
+  96.8% of its floor vs 82.6% real), but every alternative loses more:
+  XLA phase decomposition 67.8 GB, pallas first-match kernel 80.4 GB
+  (layout copies: pallas can't accept XLA's batch-minor layouts),
+  hand-written custom-vjp 95.9 GB.  See nn/layers.py SpatialMaxPooling
+  and ops/pallas_pool.py.
+- Inception MFU ceiling: at its own HBM floor (45.5 ms) MFU caps at
+  0.254, so the 0.28 target is unreachable without removing bytes the
+  model actually moves; measured 0.21 = 83% of that roofline, with the
+  S&S waste above accounting for most of the residual gap.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import statistics
 import sys
 import time
 
@@ -51,8 +75,8 @@ PEAK_BF16_FLOPS = 197e12          # v5e MXU peak
 HBM_BYTES_PER_SEC = 819e9         # v5e HBM bandwidth
 
 
-def _measure(model, batch: int, windows: int = 4, iters: int = 32):
-    """Compile + run one training step; return (img/s best window,
+def _measure(model, batch: int, windows: int = 6, iters: int = 32):
+    """Compile + run one training step; return (per-window img/s list,
     cost-analysis dict)."""
     import jax
     import jax.numpy as jnp
@@ -102,7 +126,7 @@ def _measure(model, batch: int, windows: int = 4, iters: int = 32):
                                        np.float32(0.1), np.int32(0), rng0)
     float(loss)
 
-    best = 0.0
+    samples = []
     for w in range(windows):
         t0 = time.perf_counter()
         for i in range(iters):
@@ -110,61 +134,42 @@ def _measure(model, batch: int, windows: int = 4, iters: int = 32):
                 params, mstate, ostate, x, y, np.float32(0.1),
                 np.int32(w * iters + i), rng0)
         float(loss)  # full pipeline sync
-        best = max(best, batch * iters / (time.perf_counter() - t0))
-    return best, ca
+        samples.append(batch * iters / (time.perf_counter() - t0))
+    return samples, ca
 
 
-def main():
-    from bigdl_tpu.models.resnet import resnet50
-    from bigdl_tpu.models.inception import inception_v1
-
-    batch = 256
-    r_ips, r_ca = _measure(resnet50(format="NHWC"), batch)
-    i_ips, i_ca = _measure(inception_v1(format="NHWC"), batch)
-
-    out = {
-        "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": round(r_ips, 1),
-        "unit": "images/sec",
-        "vs_baseline": round(r_ips / BASELINE_IMAGES_PER_SEC, 3),
-        "inception_v1_images_per_sec_per_chip": round(i_ips, 1),
-        "config": f"NHWC/bf16/batch{batch}/donated",
+def _stats(samples):
+    med = statistics.median(samples)
+    return med, {
+        "median": round(med, 1),
+        "min": round(min(samples), 1),
+        "max": round(max(samples), 1),
+        "rel_spread": round((max(samples) - min(samples)) / med, 4),
+        "windows": len(samples),
     }
-    if r_ca:
-        step_ms = batch / r_ips * 1e3
-        t_mxu = r_ca["flops"] / PEAK_BF16_FLOPS * 1e3
-        t_hbm = r_ca["bytes"] / HBM_BYTES_PER_SEC * 1e3
-        out["mfu"] = round(r_ips * (r_ca["flops"] / batch)
-                           / PEAK_BF16_FLOPS, 4)
-        out["bottleneck"] = {
-            "kind": "hbm" if t_hbm > t_mxu else "mxu",
-            "xla_flops_G": round(r_ca["flops"] / 1e9, 1),
-            "xla_bytes_GB": round(r_ca["bytes"] / 1e9, 2),
-            "t_mxu_floor_ms": round(t_mxu, 2),
-            "t_hbm_floor_ms": round(t_hbm, 2),
-            "t_measured_ms": round(step_ms, 2),
-            "hbm_floor_fraction": round(t_hbm / step_ms, 3),
-        }
-    if i_ca:
-        out["inception_mfu"] = round(i_ips * (i_ca["flops"] / batch)
-                                     / PEAK_BF16_FLOPS, 4)
-    print(json.dumps(out))
 
 
-def scaling():
-    """Sharding-overhead harness on a virtual CPU mesh.
+def _bottleneck(ca, ips, batch):
+    """Roofline comparison of the measured step vs the compiled
+    executable's XLA-counted flop and byte floors."""
+    step_ms = batch / ips * 1e3
+    t_mxu = ca["flops"] / PEAK_BF16_FLOPS * 1e3
+    t_hbm = ca["bytes"] / HBM_BYTES_PER_SEC * 1e3
+    return {
+        "kind": "hbm" if t_hbm > t_mxu else "mxu",
+        "xla_flops_G": round(ca["flops"] / 1e9, 1),
+        "xla_bytes_GB": round(ca["bytes"] / 1e9, 2),
+        "t_mxu_floor_ms": round(t_mxu, 2),
+        "t_hbm_floor_ms": round(t_hbm, 2),
+        "t_measured_ms": round(step_ms, 2),
+        "hbm_floor_fraction": round(t_hbm / step_ms, 3),
+    }
 
-    True multi-chip weak scaling cannot be measured on one host: the 8
-    virtual devices share the same physical cores, so contention would
-    masquerade as scaling loss.  What CAN be isolated is the overhead the
-    SPMD partitioning itself adds: run the SAME global problem (fixed
-    global batch) unsharded on 1 device vs sharded over 8 — identical
-    total CPU work, so efficiency = t(1-dev)/t(8-dev) ≈ 1 - collective/
-    partition overhead.  The real 1→32-chip ICI measurement (BASELINE
-    north star >60%) needs pod hardware the driver doesn't provide."""
-    import os
-    import subprocess
 
+def _scaling_efficiency():
+    """1-vs-8 virtual-CPU-mesh partitioning overhead (see module doc).
+    Subprocess-isolated so the TPU backend in this process is
+    untouched."""
     results = {}
     for n in (1, 8):
         env = dict(os.environ)
@@ -174,24 +179,102 @@ def scaling():
         flags.append("--xla_force_host_platform_device_count=8")
         env["XLA_FLAGS"] = " ".join(flags)
         env["_BENCH_SCALING_N"] = str(n)
-        out = subprocess.run(
-            [sys.executable, __file__, "--scaling-child"], env=env,
-            capture_output=True, text=True)
-        if out.returncode != 0:
-            print(out.stderr, file=sys.stderr)
-            raise RuntimeError(f"scaling child n={n} failed")
-        results[n] = float(out.stdout.strip().splitlines()[-1])
-    eff = round(results[8] / results[1], 3)
+        out = subprocess_run([sys.executable, __file__, "--scaling-child"],
+                             env=env)
+        if out is None:
+            return None
+        results[n] = out
+    return {
+        "value": round(results[8] / results[1], 3),
+        "images_per_sec": {str(n): round(v, 1)
+                           for n, v in results.items()},
+    }
+
+
+def subprocess_run(cmd, env):
+    import subprocess
+    out = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    if out.returncode != 0:
+        print(out.stderr[-2000:], file=sys.stderr)
+        return None
+    return float(out.stdout.strip().splitlines()[-1])
+
+
+def main(argv):
+    from bigdl_tpu.models.resnet import resnet50
+    from bigdl_tpu.models.inception import inception_v1
+
+    batch = 256
+    remat = "tails" if "--remat-tails" in argv else (
+        True if "--remat-full" in argv else False)
+    r_samples, r_ca = _measure(resnet50(format="NHWC", remat=remat), batch)
+    r_ips, r_spread = _stats(r_samples)
+    if "--resnet-only" in argv:
+        out = {"metric": "resnet50_train_images_per_sec_per_chip",
+               "value": round(r_ips, 1), "spread": r_spread,
+               "remat": str(remat)}
+        if r_ca:
+            out["bottleneck"] = _bottleneck(r_ca, r_ips, batch)
+        print(json.dumps(out))
+        return
+    i_samples, i_ca = _measure(inception_v1(format="NHWC"), batch)
+    i_ips, i_spread = _stats(i_samples)
+
+    out = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(r_ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(r_ips / BASELINE_IMAGES_PER_SEC, 3),
+        "spread": r_spread,
+        "inception_v1_images_per_sec_per_chip": round(i_ips, 1),
+        "inception_spread": i_spread,
+        "config": f"NHWC/bf16/batch{batch}/donated"
+                  + (f"/remat-{remat}" if remat else ""),
+    }
+    if r_ca:
+        out["mfu"] = round(r_ips * (r_ca["flops"] / batch)
+                           / PEAK_BF16_FLOPS, 4)
+        out["bottleneck"] = _bottleneck(r_ca, r_ips, batch)
+    if i_ca:
+        out["inception_mfu"] = round(i_ips * (i_ca["flops"] / batch)
+                                     / PEAK_BF16_FLOPS, 4)
+        out["inception_bottleneck"] = _bottleneck(i_ca, i_ips, batch)
+    sc = _scaling_efficiency()
+    if sc is not None:
+        out["scaling_efficiency"] = sc["value"]
+        out["scaling_detail"] = sc["images_per_sec"]
+        out["scaling_gate_0p6"] = "pass" if sc["value"] >= 0.6 else "FAIL"
+    else:
+        # a crashed child must read as a failed gate, not a missing key
+        out["scaling_efficiency"] = None
+        out["scaling_gate_0p6"] = "FAIL"
+        out["scaling_error"] = "scaling child subprocess failed"
+    print(json.dumps(out))
+
+
+def scaling():
+    """Standalone scaling mode (same measurement the main entry embeds).
+
+    True multi-chip weak scaling cannot be measured on one host: the 8
+    virtual devices share the same physical cores, so contention would
+    masquerade as scaling loss.  What CAN be isolated is the overhead the
+    SPMD partitioning itself adds: run the SAME global problem (fixed
+    global batch) unsharded on 1 device vs sharded over 8 — identical
+    total CPU work, so efficiency = t(1-dev)/t(8-dev) ≈ 1 - collective/
+    partition overhead.  The real 1→32-chip ICI measurement (BASELINE
+    north star >60%) needs pod hardware the driver doesn't provide."""
+    sc = _scaling_efficiency()
+    if sc is None:
+        raise RuntimeError("scaling child failed")
     print(json.dumps({
         "metric": "resnet_cifar_sharding_overhead_efficiency_cpu_mesh",
-        "value": eff,
+        "value": sc["value"],
         "unit": "parallel_efficiency",
-        "images_per_sec": {str(n): round(results[n], 1) for n in results},
+        "images_per_sec": sc["images_per_sec"],
     }))
 
 
 def scaling_child():
-    import os
     import jax
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -251,4 +334,4 @@ if __name__ == "__main__":
     elif "--scaling" in sys.argv:
         scaling()
     else:
-        main()
+        main(sys.argv[1:])

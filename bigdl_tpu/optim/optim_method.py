@@ -75,13 +75,21 @@ class SGD(OptimMethod):
                  momentum: float = 0.0,
                  dampening: Optional[float] = None,
                  nesterov: bool = False,
-                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None,
+                 state_dtype=None):
+        """``state_dtype=jnp.bfloat16`` stores the velocity in bf16 with
+        stochastic rounding (accumulate-in-f32, round-with-noise) —
+        halves optimizer-state HBM traffic and footprint.  On ResNet-50
+        that traffic is ~0.2 GB of a 78.7 GB/step budget (0.26%), so
+        this is a memory-capacity knob, not a throughput one (measured:
+        no difference beyond run noise)."""
         if learning_rate_schedule is None and learning_rate_decay != 0.0:
             learning_rate_schedule = Default(learning_rate_decay)
         super().__init__(learning_rate, learning_rate_schedule, weight_decay)
         self.momentum = momentum
         self.dampening = momentum if dampening is None else dampening
         self.nesterov = nesterov
+        self.state_dtype = state_dtype
         if nesterov and (momentum <= 0 or self.dampening != 0):
             raise ValueError(
                 "nesterov requires momentum > 0 and dampening = 0")
@@ -89,20 +97,54 @@ class SGD(OptimMethod):
     def init_state(self, params):
         if self.momentum == 0.0:
             return {}
-        return {"velocity": tmap(jnp.zeros_like, params)}
+        dt = self.state_dtype
+        mk = (lambda p: jnp.zeros(p.shape, dt)) if dt is not None \
+            else jnp.zeros_like
+        return {"velocity": tmap(mk, params)}
 
     def update(self, grads, params, opt_state, lr, step):
         grads = self._apply_weight_decay(grads, params)
         if self.momentum == 0.0:
             return tmap(lambda p, g: p - lr * g, params, grads), opt_state
         mu, damp = self.momentum, self.dampening
-        vel = tmap(lambda v, g: mu * v + (1 - damp) * g,
+        # with a reduced-precision state, accumulate in f32 so the
+        # stochastic rounding below is the ONLY precision loss — a bf16
+        # accumulate would round-to-nearest first and systematically
+        # drop sub-ulp updates (the bias SR exists to remove)
+        acc = jnp.float32 if self.state_dtype is not None else None
+        vel = tmap(lambda v, g: mu * v.astype(acc or g.dtype)
+                   + (1 - damp) * g.astype(acc or g.dtype),
                    opt_state["velocity"], grads)
         if self.nesterov:
             upd = tmap(lambda g, v: g + mu * v, grads, vel)
         else:
             upd = vel
-        return tmap(lambda p, u: p - lr * u, params, upd), {"velocity": vel}
+        new_params = tmap(lambda p, u: p - lr * u, params, upd)
+        if self.state_dtype is not None:
+            key = jax.random.fold_in(jax.random.PRNGKey(0x5bd1), step)
+            leaves = jax.tree_util.tree_leaves(vel)
+            keys = jax.random.split(key, len(leaves))
+            keys = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(vel), list(keys))
+            vel = tmap(lambda v, k: _stochastic_round(v, self.state_dtype, k),
+                       vel, keys)
+        return new_params, {"velocity": vel}
+
+
+def _stochastic_round(x, dtype, key):
+    """Unbiased f32→bf16 rounding: add uniform random low-16 bits, then
+    truncate (bf16 is exactly the top 16 bits of f32).  Plain
+    round-to-nearest would systematically drop momentum updates smaller
+    than half a bf16 ulp; the expectation of this rounding is ``x``."""
+    if x.dtype == dtype:
+        return x
+    if dtype != jnp.bfloat16 or x.dtype != jnp.float32:
+        return x.astype(dtype)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    bits = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(
+        jnp.bfloat16)
 
 
 class Adam(OptimMethod):
