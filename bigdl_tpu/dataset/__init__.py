@@ -2,7 +2,8 @@
 ``DL/transform/vision/``)."""
 
 from bigdl_tpu.dataset.sample import (
-    Sample, MiniBatch, PaddingParam, batch_samples,
+    Sample, MiniBatch, PaddingParam, SparseSample, SparseMiniBatch,
+    batch_samples, batch_sparse_samples,
 )
 from bigdl_tpu.dataset.transformer import (
     Transformer, ChainedTransformer, FnTransformer, SampleToMiniBatch,

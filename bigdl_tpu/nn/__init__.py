@@ -71,8 +71,8 @@ from bigdl_tpu.nn.regularizers import (
     L1L2Regularizer, L1Regularizer, L2Regularizer, regularization_loss,
 )
 from bigdl_tpu.nn.sparse import (
-    LookupTableSparse, SparseLinear, SparseJoinTable, DenseToSparse,
-    dense_to_bags,
+    COOBatch, LookupTableSparse, SparseLinear, SparseJoinTable,
+    DenseToSparse, coo_row_reduce, coo_spmm, dense_to_bags,
 )
 from bigdl_tpu.nn.volumetric import (
     VolumetricConvolution, VolumetricMaxPooling, VolumetricAveragePooling,

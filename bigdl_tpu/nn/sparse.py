@@ -4,19 +4,31 @@ Reference: ``DL/tensor/SparseTensor.scala`` (COO) + ``nn/SparseLinear``,
 ``nn/LookupTableSparse``, ``nn/SparseJoinTable``, ``nn/DenseToSparse`` —
 the Wide&Deep / NCF path named in BASELINE.json.
 
-TPU redesign: COO sparse×dense gemm is the WRONG primitive on TPU (the MXU
-wants dense tiles; scatter/gather beats sparse matmul).  The equivalent
-representation is **fixed-width id bags**: each sample carries up to
-``bag_size`` (id, weight) pairs, padded with id = -1.  A sparse feature
-vector x with nnz entries (i, v) then maps to ids=i, weights=v, and
-``SparseLinear``'s W @ x becomes a weighted embedding-bag sum — one gather
-+ segment-sum, which is exactly how TPU recommenders are built.  Fixed
-width keeps shapes static for XLA (ragged bags are bucketed host-side).
+TPU redesign, two sparse representations:
+
+1. **Fixed-width id bags** (ids (N, B) with -1 padding + weights): COO
+   sparse×dense gemm is the WRONG primitive on TPU (the MXU wants dense
+   tiles; scatter/gather beats sparse matmul), so a sparse feature
+   vector maps to a weighted embedding-bag sum — one gather +
+   batched reduction.  Best when every sample has a similar, small nnz.
+
+2. **Batch COO** (:class:`COOBatch`: flat ``row``/``col``/``values``
+   with a static total-nnz, the device form of the reference's
+   ``SparseMiniBatch``, ``DL/dataset/MiniBatch.scala:588`` /
+   ``SparseTensorBLAS.scala``): the whole batch's non-zeros in one flat
+   stream, executed with ``jax.ops.segment_sum`` kernels.  Best for
+   ragged nnz (no per-sample width cap); host batching pads the flat
+   stream to an nnz bucket so shapes stay static for XLA
+   (``dataset/sample.py`` ``batch_sparse_samples``).
+
+Both forms feed the same layers: :class:`SparseLinear` /
+:class:`LookupTableSparse` accept bags or a :class:`COOBatch`.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from dataclasses import dataclass
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +36,47 @@ import numpy as np
 
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.nn.initialization import RandomNormal, RandomUniform
+
+
+@dataclass(frozen=True)
+class COOBatch:
+    """Device-side batch-COO sparse matrix of shape ``dense_shape`` =
+    (N, D): ``values[k]`` sits at (``row[k]``, ``col[k]``).  Padding
+    entries carry ``row = col = 0, value = 0`` (they contribute
+    nothing).  ``dense_shape`` is static (pytree metadata) so
+    ``segment_sum`` gets a compile-time segment count."""
+
+    row: jnp.ndarray      # (NNZ,) int32
+    col: jnp.ndarray      # (NNZ,) int32
+    values: jnp.ndarray   # (NNZ,) float
+    dense_shape: Tuple[int, int]
+
+    @property
+    def n_rows(self) -> int:
+        return self.dense_shape[0]
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.row, self.col].add(self.values)
+
+
+jax.tree_util.register_dataclass(
+    COOBatch, data_fields=["row", "col", "values"],
+    meta_fields=["dense_shape"])
+
+
+def coo_spmm(coo: COOBatch, dense):
+    """Sparse×dense matmul ``(N, D) @ (D, O) -> (N, O)`` as gather +
+    segment-sum (the reference's ``SparseTensorBLAS`` coomm role, built
+    on the TPU-friendly primitive instead of a sparse gemm)."""
+    gathered = jnp.take(dense, coo.col, axis=0) * coo.values[:, None]
+    return jax.ops.segment_sum(gathered, coo.row,
+                               num_segments=coo.n_rows)
+
+
+def coo_row_reduce(coo: COOBatch, values):
+    """Per-row sum of ``values`` (one scalar per non-zero)."""
+    return jax.ops.segment_sum(values, coo.row, num_segments=coo.n_rows)
 
 
 def dense_to_bags(dense: np.ndarray, bag_size: Optional[int] = None):
@@ -70,7 +123,8 @@ class LookupTableSparse(Module):
     combiner sum/mean/sqrtn over each sample's ids, optional per-id
     weights).
 
-    Input: ids (N, B) int with -1 padding, or (ids, weights) tuple.
+    Input: ids (N, B) int with -1 padding, a (ids, weights) tuple, or a
+    :class:`COOBatch` (rows = samples, cols = ids, values = weights).
     Output: (N, n_output)."""
 
     def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
@@ -87,7 +141,20 @@ class LookupTableSparse(Module):
                                   self.n_index, self.n_output)
         return {"weight": w}, {}
 
+    def _apply_coo(self, params, coo: COOBatch):
+        summed = coo_spmm(coo, params["weight"])
+        if self.combiner == "sum":
+            return summed
+        w = coo.values
+        if self.combiner == "mean":
+            denom = coo_row_reduce(coo, jnp.abs(w))
+        else:  # sqrtn
+            denom = jnp.sqrt(coo_row_reduce(coo, w * w))
+        return summed / jnp.maximum(denom[:, None], 1e-12)
+
     def apply(self, params, state, input, *, training=False, rng=None):
+        if isinstance(input, COOBatch):
+            return self._apply_coo(params, input), state
         if isinstance(input, (tuple, list)):
             ids, weights = input
         else:
@@ -111,8 +178,9 @@ class LookupTableSparse(Module):
 class SparseLinear(Module):
     """Affine layer on sparse inputs (reference ``SparseLinear.scala``:
     sparse×dense addmm).  Input: (ids, values) bags representing sparse
-    rows of width ``input_size``; computed as a weighted embedding-bag over
-    the weight's columns + bias — mathematically identical to W @ x + b."""
+    rows of width ``input_size``, or a :class:`COOBatch`; computed as a
+    weighted embedding-bag / segment-sum over the weight's columns +
+    bias — mathematically identical to W @ x + b."""
 
     def __init__(self, input_size: int, output_size: int,
                  with_bias: bool = True, name: Optional[str] = None):
@@ -133,23 +201,42 @@ class SparseLinear(Module):
         return params, {}
 
     def apply(self, params, state, input, *, training=False, rng=None):
-        y, _ = self._bag.apply({"weight": params["weight"]}, {}, input)
+        if isinstance(input, COOBatch):
+            y = coo_spmm(input, params["weight"])
+        else:
+            y, _ = self._bag.apply({"weight": params["weight"]}, {}, input)
         if self.with_bias:
             y = y + params["bias"]
         return y, state
 
 
 class SparseJoinTable(Module):
-    """Concatenate bag-form sparse features (reference
-    ``SparseJoinTable.scala`` concatenates COO tensors along dim 1).
-    Input: sequence of (ids, weights) whose id spaces are offset by each
-    predecessor's ``input_size``; sizes given at construction."""
+    """Concatenate sparse features along dim 1 (reference
+    ``SparseJoinTable.scala`` concatenates COO tensors).
+    Input: sequence of (ids, weights) bags OR of :class:`COOBatch`es,
+    whose id spaces are offset by each predecessor's ``input_size``;
+    sizes given at construction."""
 
     def __init__(self, sizes, name: Optional[str] = None):
         super().__init__(name)
         self.sizes = list(sizes)
 
     def apply(self, params, state, input, *, training=False, rng=None):
+        if all(isinstance(t, COOBatch) for t in input):
+            rows, cols, vals = [], [], []
+            offset = 0
+            n = input[0].n_rows
+            if any(coo.n_rows != n for coo in input):
+                raise ValueError(
+                    "SparseJoinTable inputs disagree on batch size: "
+                    f"{[coo.n_rows for coo in input]}")
+            for coo, size in zip(input, self.sizes):
+                rows.append(coo.row)
+                cols.append(coo.col + offset)
+                vals.append(coo.values)
+                offset += size
+            return COOBatch(jnp.concatenate(rows), jnp.concatenate(cols),
+                            jnp.concatenate(vals), (n, offset)), state
         ids_out, w_out = [], []
         offset = 0
         for (ids, w), size in zip(input, self.sizes):

