@@ -7,8 +7,10 @@ to an RDD, and hands the whole thing to DistriOptimizer.
 TPU redesign: the imported :class:`TFGraphModule` is already a normal
 functional module whose VariableV2 nodes are trainable params, so
 "session training" is just adapter glue: pick the loss output (or an
-output + criterion), feed batches from a ``DataSet``, and drive
-``LocalOptimizer``/``DistriOptimizer``.
+output + criterion), feed batches from a ``DataSet`` — or, when the
+graph carries its OWN input pipeline (queue runners), replay that
+pipeline host-side (``interop/tf_queues.py``) and feed the dequeue
+node, exactly the substitution the reference makes.
 """
 
 from __future__ import annotations
@@ -19,33 +21,63 @@ import numpy as np
 
 from bigdl_tpu import nn, optim
 from bigdl_tpu.dataset.dataset import AbstractDataSet
-from bigdl_tpu.interop.tf_format import TFGraphModule, load_tf_graph
+from bigdl_tpu.interop.tf_format import (TFGraphModule, load_tf_graph,
+                                         parse_graphdef_binary,
+                                         parse_graphdef_text)
 
 
 class TFSession:
     """(reference ``BigDLSessionImpl``) — train/fine-tune an imported
-    GraphDef with the framework's optimizers."""
+    GraphDef with the framework's optimizers.
+
+    With ``inputs=None``, the graph must be queue-fed: the in-graph
+    input pipeline (filename queue → reader → decode → example queue →
+    dequeue) is detected and replayed host-side, and the dequeue node
+    becomes the feed point (``Session.scala:111-165``)."""
 
     def __init__(self, graph_or_path, inputs: Optional[Sequence[str]] = None,
                  outputs: Optional[Sequence[str]] = None):
+        self.pipeline = None
         if isinstance(graph_or_path, TFGraphModule):
             self.graph = graph_or_path
-        else:
-            if inputs is None or outputs is None:
-                raise ValueError("loading from a path needs inputs= and "
-                                 "outputs= node names")
+            return
+        if outputs is None:
+            raise ValueError("loading from a path needs outputs= node names")
+        if inputs is not None:
             self.graph = load_tf_graph(graph_or_path, inputs, outputs)
+            return
+        # queue-fed: detect the in-graph pipeline, feed at the dequeue
+        from bigdl_tpu.interop.tf_queues import QueuePipeline
+        with open(graph_or_path, "rb") as f:
+            data = f.read()
+        if str(graph_or_path).endswith((".pbtxt", ".txt")):
+            nodes = parse_graphdef_text(data.decode("utf-8"))
+        else:
+            nodes = parse_graphdef_binary(data)
+        self.pipeline = QueuePipeline(nodes, outputs)
+        self.graph = TFGraphModule(nodes, [self.pipeline.dequeue], outputs)
+        self.graph.initialize()
 
-    def train(self, dataset: AbstractDataSet,
-              criterion: nn.Criterion,
+    def train(self, dataset: Optional[AbstractDataSet] = None,
+              criterion: Optional[nn.Criterion] = None,
               optim_method: Optional[optim.OptimMethod] = None,
               end_when: Optional[optim.Trigger] = None,
-              distributed: bool = False, mesh=None):
-        """Train the imported graph's variables on ``dataset``
-        (reference ``Session.train:111``).  The optimizer pairs the
-        graph's output with ``criterion`` against each batch's target and
-        writes the trained variables back onto the module.  Returns the
-        optimizer (its ``state`` carries loss/epoch)."""
+              distributed: bool = False, mesh=None, epochs: int = 1):
+        """Train the imported graph's variables (reference
+        ``Session.train:111``).
+
+        - with a ``dataset``: the optimizer pairs the graph's output
+          with ``criterion`` against each batch's target;
+        - with ``dataset=None`` (queue-fed graphs): batches come from
+          the replayed in-graph pipeline, and the graph's (scalar)
+          output is minimized directly — the loss lives in-graph, as in
+          the reference's session training.
+        Returns the optimizer (its ``state`` carries loss/epoch), or
+        the per-step loss list for the queue-fed path."""
+        if dataset is None:
+            return self._train_queue_fed(optim_method, epochs, end_when)
+        if criterion is None:
+            raise ValueError("dataset training needs a criterion")
         if distributed:
             opt = optim.DistriOptimizer(self.graph, dataset, criterion,
                                         mesh=mesh)
@@ -53,9 +85,54 @@ class TFSession:
             opt = optim.LocalOptimizer(self.graph, dataset, criterion)
         opt.set_optim_method(optim_method or optim.SGD(
             learning_rate=0.01, momentum=0.9, dampening=0.0))
-        opt.set_end_when(end_when or optim.max_epoch(1))
+        opt.set_end_when(end_when or optim.max_epoch(epochs))
         opt.optimize()
         return opt
+
+    def _train_queue_fed(self, optim_method, epochs: int,
+                         end_when: Optional[optim.Trigger] = None):
+        if self.pipeline is None:
+            raise ValueError(
+                "train(dataset=None) needs an in-graph queue pipeline "
+                "(load via TFSession(path, outputs=...) with inputs=None)")
+        import jax
+        import jax.numpy as jnp
+
+        m = self.graph
+        method = optim_method or optim.SGD(learning_rate=0.01,
+                                           momentum=0.9, dampening=0.0)
+        params = m._params
+        ostate = method.init_state(params)
+
+        @jax.jit
+        def step(params, ostate, feeds, lr, it):
+            def loss_fn(p):
+                out, _ = m.apply(p, {}, feeds)
+                return jnp.mean(jnp.asarray(out))
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            params, ostate = method.update(g, params, ostate, lr, it)
+            return params, ostate, loss
+
+        losses = []
+        it = 0
+        stop = False
+        for epoch in range(epochs):
+            for feeds in self.pipeline.batches(epochs=1, seed=epoch):
+                feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+                lr = method.current_lr(it, epoch)
+                params, ostate, loss = step(params, ostate, feeds,
+                                            np.float32(lr), it)
+                losses.append(float(loss))
+                it += 1
+                if end_when is not None and end_when(
+                        {"neval": it, "epoch": epoch,
+                         "score": losses[-1]}):
+                    stop = True
+                    break
+            if stop:
+                break
+        m._params = params
+        return losses
 
     def run(self, feeds) -> np.ndarray:
         """Forward the graph on host arrays (``session.run`` analog)."""

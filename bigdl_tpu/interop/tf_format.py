@@ -550,7 +550,10 @@ class TFGraphModule(Module):
                 folded[nm] = np.asarray(node["attrs"]["value"])
                 continue
             if op in dynamic_ops or nm in self.feed_points \
-                    or nm in self._node_frame:
+                    or nm in self._node_frame \
+                    or op.startswith("TensorArray"):
+                # TensorArray ops produce handle/flow objects, not
+                # foldable arrays
                 continue
             args = []
             ok = True
@@ -585,6 +588,8 @@ class TFGraphModule(Module):
         node = self.by_name.get(nm)
         if node is None:
             return None
+        if node["op"].startswith("TensorArray"):
+            return None  # handle/flow objects, not arrays
         if node["op"] == "Const":
             return np.asarray(node["attrs"]["value"])
         args = []
@@ -686,11 +691,41 @@ class TFGraphModule(Module):
             v = v[ix] if isinstance(v, tuple) else v
             return _tag_value(v)
 
-        # initial carry: the Enter inputs (outer values), merge-ordered
-        carry0 = tuple(jnp.asarray(outer_value(e["inputs"][0]))
-                       for e in fr.enters)
         invariant_bind = {inv["name"]: outer_value(inv["inputs"][0])
                           for inv in fr.invariants}
+
+        # map each NextIteration to its loop variable (via its Merge)
+        nextit_of_merge = {}
+        for m, e in zip(fr.merges, fr.enters):
+            for inp in m["inputs"]:
+                bse = _base_name(inp)[0]
+                if bse != e["name"]:
+                    nextit_of_merge[m["name"]] = self.by_name[bse]
+
+        # initial carry: the Enter inputs (outer values), merge-ordered.
+        # A TensorArray flow entering with unknown element shape
+        # (TAPending) is resolved by probing the body once: the write op
+        # inside allocates real storage, whose shape/dtype seeds the
+        # zero-initialised carry (ops/registry.py TensorArray family).
+        from bigdl_tpu.ops.registry import TAPending
+        raw0 = [outer_value(e["inputs"][0]) for e in fr.enters]
+        if any(isinstance(v, TAPending) for v in raw0):
+            probe_bind = dict(invariant_bind)
+            for m, c in zip(fr.merges, raw0):
+                probe_bind[m["name"]] = c
+            probe_memo: Dict[str, Any] = {}
+            for i, (m, v) in enumerate(zip(fr.merges, raw0)):
+                if not isinstance(v, TAPending):
+                    continue
+                ni = nextit_of_merge.get(m["name"])
+                if ni is None:
+                    raise NotImplementedError(
+                        f"TensorArray flow {m['name']} is never written "
+                        "inside its loop; element shape unknown")
+                out = self._eval_interior(fr, probe_bind, values,
+                                          ni["inputs"][0], probe_memo)
+                raw0[i] = jnp.zeros_like(out)
+        carry0 = tuple(jnp.asarray(v) for v in raw0)
 
         def bindings(carry):
             bind = dict(invariant_bind)
@@ -702,14 +737,6 @@ class TFGraphModule(Module):
             b = self._eval_interior(fr, bindings(carry), values,
                                     fr.loop_cond["inputs"][0])
             return jnp.reshape(jnp.asarray(b, bool), ())
-
-        # map each NextIteration to its loop variable (via its Merge)
-        nextit_of_merge = {}
-        for m, e in zip(fr.merges, fr.enters):
-            for inp in m["inputs"]:
-                bse = _base_name(inp)[0]
-                if bse != e["name"]:
-                    nextit_of_merge[m["name"]] = self.by_name[bse]
 
         def body(carry):
             bind = bindings(carry)

@@ -639,15 +639,20 @@ def _to_bytes_list(x):
     return [bytes(v) for v in arr]
 
 
+# TF DataType enum → numpy dtype (one map for every op that reads a
+# dtype/out_type attr)
+_TF_DT_NP = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
+             5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_,
+             14: jnp.bfloat16, 17: np.uint16, 19: np.float16,
+             22: np.uint32}
+
+
 @register_op("DecodeRaw")
 def _decode_raw(attrs, data):
     dt = int(attrs.get("out_type", 1))
-    mapping = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
-               6: np.int8, 9: np.int64, 17: np.uint16, 5: np.int16,
-               19: np.float16, 22: np.uint32, 10: np.bool_}
-    if dt not in mapping:
+    if dt not in _TF_DT_NP:
         raise NotImplementedError(f"DecodeRaw out_type {dt}")
-    dtype = np.dtype(mapping[dt])
+    dtype = np.dtype(_TF_DT_NP[dt])
     if not bool(attrs.get("little_endian", True)) and dtype.itemsize > 1:
         dtype = dtype.newbyteorder(">")
     payloads = _to_bytes_list(data)
@@ -717,6 +722,90 @@ def _decode_gif(attrs, contents):
     frames = [np.asarray(f.convert("RGB"), np.uint8)
               for f in ImageSequence.Iterator(img)]
     return np.stack(frames)
+
+
+# --------------------------------------------------------- TensorArray
+# (reference ``DL/nn/tf/DataFlowOps.scala``: TensorArray read/write/
+# gather/scatter used by dynamic-RNN exports.)
+#
+# TPU redesign: a TensorArray IS its storage.  The op family threads a
+# "flow" value; here the flow VALUE is the (size, *elem) stacked array,
+# so writes are functional .at[].set updates and the array can be a
+# loop-carried variable of the imported while frame.  Element shape is
+# unknown until the first write — ``TAPending`` defers allocation, and
+# the frame executor (tf_format._run_frame) probes the loop body once
+# to resolve pending flows into zero-initialised storage.
+
+
+class TAHandle:
+    """Opaque handle value of TensorArrayV3:0 (size/dtype metadata)."""
+
+    __slots__ = ("name", "size", "dtype")
+
+    def __init__(self, name, size, dtype):
+        self.name, self.size, self.dtype = name, size, dtype
+
+
+class TAPending:
+    """Flow of a TensorArray whose element shape is not yet known."""
+
+    __slots__ = ("size", "dtype")
+
+    def __init__(self, size, dtype):
+        self.size, self.dtype = size, dtype
+
+
+def _ta_alloc(flow, value, leading_from_value=False):
+    if not isinstance(flow, TAPending):
+        return flow
+    elem = value.shape[1:] if leading_from_value else value.shape
+    return jnp.zeros((flow.size,) + tuple(elem), value.dtype)
+
+
+@register_op("TensorArrayV3")
+def _tensor_array(attrs, size):
+    size = int(np.asarray(size))
+    dt = _TF_DT_NP.get(int(attrs.get("dtype", 1)), np.float32)
+    return (TAHandle(attrs.get("_node_name"), size, dt),
+            TAPending(size, dt))
+
+
+@register_op("TensorArrayWriteV3")
+def _ta_write(attrs, handle, index, value, flow):
+    flow = _ta_alloc(flow, value)
+    return flow.at[jnp.asarray(index)].set(value)
+
+
+@register_op("TensorArrayReadV3")
+def _ta_read(attrs, handle, index, flow):
+    if isinstance(flow, TAPending):
+        raise NotImplementedError(
+            "TensorArrayReadV3 before any write: element shape unknown")
+    return jnp.take(flow, jnp.asarray(index), axis=0)
+
+
+@register_op("TensorArrayGatherV3")
+def _ta_gather(attrs, handle, indices, flow):
+    if isinstance(flow, TAPending):
+        raise NotImplementedError(
+            "TensorArrayGatherV3 before any write: element shape unknown")
+    return jnp.take(flow, jnp.asarray(indices).astype(jnp.int32), axis=0)
+
+
+@register_op("TensorArrayScatterV3")
+def _ta_scatter(attrs, handle, indices, value, flow):
+    flow = _ta_alloc(flow, value, leading_from_value=True)
+    return flow.at[jnp.asarray(indices).astype(jnp.int32)].set(value)
+
+
+@register_op("TensorArraySizeV3")
+def _ta_size(attrs, handle, flow):
+    return jnp.asarray(handle.size, jnp.int32)
+
+
+@register_op("TensorArrayCloseV3")
+def _ta_close(attrs, handle):
+    return jnp.zeros((), jnp.float32)
 
 
 @register_op("ParseExample")

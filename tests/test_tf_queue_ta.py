@@ -1,0 +1,235 @@
+"""Queue-fed TF graphs + TensorArray import (VERDICT r3 items 4;
+reference Session.scala:111-165, DataFlowOps.scala)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.dataset import tfrecord
+from bigdl_tpu.interop.session import TFSession
+from bigdl_tpu.utils import protowire as pw
+
+from tfgraph_util import (node, attr_tensor, scalar_const, shape_const,
+                          string_const, int_scalar_const, attr_int,
+                          attr_type, enter)
+
+
+def build_queue_graph(record_path, batch=8):
+    """GraphDef with its WHOLE input pipeline in-graph:
+    string_input_producer -> TFRecordReader -> DecodeRaw -> example
+    queue -> QueueDequeueManyV2 -> linear regression -> in-graph MSE
+    loss."""
+    g = b""
+    g += node("filenames", "Const", value=string_const([record_path]))
+    g += node("fq", "FIFOQueueV2")
+    g += node("fq_enq", "QueueEnqueueManyV2", ["fq", "filenames"])
+    g += node("reader", "TFRecordReaderV2")
+    g += node("read", "ReaderReadV2", ["reader", "fq"])
+    g += node("decoded", "DecodeRaw", ["read:1"], out_type=attr_type(1))
+    g += node("rec", "Reshape", ["decoded", "rec_shape"])
+    g += node("rec_shape", "Const", value=shape_const([5]))
+    g += node("eq", "FIFOQueueV2")
+    g += node("eq_enq", "QueueEnqueueV2", ["eq", "rec"])
+    g += node("batch_n", "Const", value=int_scalar_const(batch))
+    g += node("dq", "QueueDequeueManyV2", ["eq", "batch_n"])
+    g += node("xb", "Const", value=shape_const([0, 0]))
+    g += node("xs", "Const", value=shape_const([-1, 4]))
+    g += node("x", "Slice", ["dq", "xb", "xs"])
+    g += node("yb", "Const", value=shape_const([0, 4]))
+    g += node("ys", "Const", value=shape_const([-1, 1]))
+    g += node("y", "Slice", ["dq", "yb", "ys"])
+    g += node("w_init", "Const", value=attr_tensor(np.zeros((4, 1))))
+    g += node("W", "VariableV2")
+    g += node("W_assign", "Assign", ["W", "w_init"])
+    g += node("pred", "MatMul", ["x", "W"])
+    g += node("diff", "Sub", ["pred", "y"])
+    g += node("sq", "Square", ["diff"])
+    g += node("red", "Const", value=shape_const([0, 1]))
+    g += node("loss", "Mean", ["sq", "red"])
+    return g
+
+
+def build_dynrnn_graph(T, B, I, H, rng):
+    """Dynamic-RNN-style export: input scattered into a TensorArray,
+    a while loop reading x_t / writing h_t via TensorArray ops, and a
+    post-loop TensorArrayGather of the outputs (the tf.nn.dynamic_rnn
+    wire pattern; reference DataFlowOps.scala)."""
+    W = rng.normal(0, 0.5, (I, H)).astype(np.float32)
+    U = rng.normal(0, 0.5, (H, H)).astype(np.float32)
+    idx_t = pw.enc_bytes(8, (pw.enc_varint(1, 3)
+                             + pw.enc_bytes(2, pw.enc_bytes(
+                                 2, pw.enc_varint(1, T)))
+                             + pw.enc_bytes(4, np.arange(
+                                 T, dtype=np.int32).tobytes())))
+    g = (node("x", "Placeholder")
+         + node("Wc", "Const", value=attr_tensor(W))
+         + node("Uc", "Const", value=attr_tensor(U))
+         + node("h0", "Const", value=attr_tensor(np.zeros((B, H))))
+         + node("T_n", "Const", value=int_scalar_const(T))
+         + node("zero_i", "Const", value=int_scalar_const(0))
+         + node("one_i", "Const", value=int_scalar_const(1))
+         + node("range_t", "Const", value=idx_t)
+         # input TA, filled before the loop
+         + node("in_ta", "TensorArrayV3", ["T_n"], dtype=attr_type(1))
+         + node("in_flow", "TensorArrayScatterV3",
+                ["in_ta", "range_t", "x", "in_ta:1"])
+         # output TA, written inside the loop
+         + node("out_ta", "TensorArrayV3", ["T_n"], dtype=attr_type(1))
+         # while frame
+         + enter("t_ent", ["zero_i"], "rnn")
+         + enter("h_ent", ["h0"], "rnn")
+         + enter("of_ent", ["out_ta:1"], "rnn")
+         + node("t_mrg", "Merge", ["t_ent", "t_ni"])
+         + node("h_mrg", "Merge", ["h_ent", "h_ni"])
+         + node("of_mrg", "Merge", ["of_ent", "of_ni"])
+         + node("lt", "Less", ["t_mrg", "T_n"])
+         + node("lc", "LoopCond", ["lt"])
+         + node("t_sw", "Switch", ["t_mrg", "lc"])
+         + node("h_sw", "Switch", ["h_mrg", "lc"])
+         + node("of_sw", "Switch", ["of_mrg", "lc"])
+         + node("x_t", "TensorArrayReadV3", ["in_ta", "t_sw:1", "in_flow"])
+         + node("xw", "MatMul", ["x_t", "Wc"])
+         + node("hu", "MatMul", ["h_sw:1", "Uc"])
+         + node("s", "Add", ["xw", "hu"])
+         + node("h_new", "Tanh", ["s"])
+         + node("of_w", "TensorArrayWriteV3",
+                ["out_ta", "t_sw:1", "h_new", "of_sw:1"])
+         + node("t_add", "Add", ["t_sw:1", "one_i"])
+         + node("t_ni", "NextIteration", ["t_add"])
+         + node("h_ni", "NextIteration", ["h_new"])
+         + node("of_ni", "NextIteration", ["of_w"])
+         + node("t_exit", "Exit", ["t_sw:0"])
+         + node("h_exit", "Exit", ["h_sw:0"])
+         + node("of_exit", "Exit", ["of_sw:0"])
+         # stack outputs after the loop
+         + node("ys", "TensorArrayGatherV3",
+                ["out_ta", "range_t", "of_exit"])
+         + node("out", "Identity", ["ys"]))
+    return g, W, U
+
+
+class TestTensorArrayRNN:
+    def _reference(self, x, W, U):
+        T, B = x.shape[0], x.shape[1]
+        h = np.zeros((B, U.shape[0]), np.float32)
+        ys = []
+        for t in range(T):
+            h = np.tanh(x[t] @ W + h @ U)
+            ys.append(h)
+        return np.stack(ys)
+
+    def test_imports_and_matches_numpy(self, tmp_path):
+        from bigdl_tpu.interop.tf_format import load_tf_graph
+        rng = np.random.default_rng(0)
+        T, B, I, H = 5, 3, 4, 6
+        g, W, U = build_dynrnn_graph(T, B, I, H, rng)
+        p = str(tmp_path / "dynrnn.pb")
+        open(p, "wb").write(g)
+        m = load_tf_graph(p, inputs=["x"], outputs=["out"])
+        x = rng.normal(0, 1, (T, B, I)).astype(np.float32)
+        out = np.asarray(m.forward(x))
+        assert out.shape == (T, B, H)
+        np.testing.assert_allclose(out, self._reference(x, W, U),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_differentiable_through_tensorarray_loop(self, tmp_path):
+        """The bounded loop compiles to lax.scan, so the imported RNN
+        TRAINS: gradient wrt the input flows through TensorArray
+        read/write."""
+        from bigdl_tpu.interop.tf_format import load_tf_graph
+        rng = np.random.default_rng(1)
+        T, B, I, H = 4, 2, 3, 5
+        g, W, U = build_dynrnn_graph(T, B, I, H, rng)
+        p = str(tmp_path / "dynrnn2.pb")
+        open(p, "wb").write(g)
+        m = load_tf_graph(p, inputs=["x"], outputs=["out"])
+        x = jnp.asarray(rng.normal(0, 1, (T, B, I)).astype(np.float32))
+
+        def loss(x):
+            out, _ = m.apply({}, {}, {"x": x})
+            return jnp.sum(out ** 2)
+
+        grad = jax.jit(jax.grad(loss))(x)
+        assert grad.shape == x.shape
+        # numerical check on one coordinate
+        eps = 1e-3
+        xp = x.at[1, 0, 2].add(eps)
+        xm = x.at[1, 0, 2].add(-eps)
+        num = (float(loss(xp)) - float(loss(xm))) / (2 * eps)
+        assert abs(num - float(grad[1, 0, 2])) < 5e-2 * max(1, abs(num))
+
+
+class TestQueueFedTraining:
+    def test_e2e_tfrecord_queue_train(self, tmp_path):
+        # data: y = x @ [1, -2, 3, 0.5]
+        rng = np.random.default_rng(0)
+        true_w = np.float32([1.0, -2.0, 3.0, 0.5])
+        records = []
+        for _ in range(64):
+            x = rng.normal(0, 1, 4).astype(np.float32)
+            y = np.float32(x @ true_w)
+            records.append(np.concatenate([x, [y]]).tobytes())
+        rec_path = str(tmp_path / "train.tfrecord")
+        tfrecord.write_records(rec_path, records)
+
+        pb = str(tmp_path / "g.pb")
+        with open(pb, "wb") as f:
+            f.write(build_queue_graph(rec_path))
+
+        from bigdl_tpu import optim
+        sess = TFSession(pb, outputs=["loss"])
+        assert sess.pipeline is not None
+        assert sess.pipeline.batch_size == 8
+        losses = sess.train(optim_method=optim.SGD(learning_rate=0.1),
+                            epochs=25)
+        assert len(losses) == 8 * 25
+        assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+        # trained weights approach the generator
+        w = np.asarray(sess.graph._params["W"]).reshape(-1)
+        np.testing.assert_allclose(w, true_w, atol=0.15)
+
+    def test_cached_const_enqueue(self, tmp_path):
+        """Session.scala's 'cached' case: EnqueueMany of constant
+        tensors, no reader."""
+        xs = np.arange(12, dtype=np.float32).reshape(6, 2)
+        g = b""
+        g += node("data", "Const", value=attr_tensor(xs))
+        g += node("q", "FIFOQueueV2")
+        g += node("enq", "QueueEnqueueManyV2", ["q", "data"])
+        g += node("n", "Const", value=int_scalar_const(3))
+        g += node("dq", "QueueDequeueManyV2", ["q", "n"])
+        g += node("two", "Const", value=scalar_const(2.0))
+        g += node("out", "Mul", ["dq", "two"])
+        pb = str(tmp_path / "cached.pb")
+        with open(pb, "wb") as f:
+            f.write(g)
+        sess = TFSession(pb, outputs=["out"])
+        feeds = list(sess.pipeline.batches())
+        assert len(feeds) == 2
+        out = sess.run({k: v for k, v in feeds[0].items()})
+        np.testing.assert_allclose(np.asarray(out), xs[:3] * 2)
+
+    def test_shuffle_queue_reorders(self, tmp_path):
+        recs = [np.float32([i]).tobytes() for i in range(32)]
+        rec_path = str(tmp_path / "s.tfrecord")
+        tfrecord.write_records(rec_path, recs)
+        g = b""
+        g += node("filenames", "Const", value=string_const([rec_path]))
+        g += node("fq", "FIFOQueueV2")
+        g += node("fq_enq", "QueueEnqueueManyV2", ["fq", "filenames"])
+        g += node("reader", "TFRecordReaderV2")
+        g += node("read", "ReaderReadV2", ["reader", "fq"])
+        g += node("v", "DecodeRaw", ["read:1"], out_type=attr_type(1))
+        g += node("q", "RandomShuffleQueueV2")
+        g += node("enq", "QueueEnqueueV2", ["q", "v"])
+        g += node("n", "Const", value=int_scalar_const(32))
+        g += node("dq", "QueueDequeueManyV2", ["q", "n"])
+        g += node("out", "Identity", ["dq"])
+        pb = str(tmp_path / "shuf.pb")
+        with open(pb, "wb") as f:
+            f.write(g)
+        sess = TFSession(pb, outputs=["out"])
+        batch = next(iter(sess.pipeline.batches(seed=3)))
+        vals = batch["dq:0"].reshape(-1)
+        assert sorted(vals.tolist()) == list(range(32))
+        assert vals.tolist() != list(range(32))  # actually shuffled

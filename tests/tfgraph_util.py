@@ -43,6 +43,33 @@ def shape_const(dims):
     return pw.enc_bytes(8, t)
 
 
+def string_const(strings):
+    """DT_STRING vector TensorProto attr payload."""
+    t = pw.enc_varint(1, 7)  # DT_STRING
+    shp = pw.enc_bytes(2, pw.enc_varint(1, len(strings)))
+    t += pw.enc_bytes(2, shp)
+    for s in strings:
+        t += pw.enc_bytes(8, s.encode() if isinstance(s, str) else s)
+    return pw.enc_bytes(8, t)
+
+
+def int_scalar_const(v):
+    """int32 scalar TensorProto attr payload."""
+    t = (pw.enc_varint(1, 3) + pw.enc_bytes(2, b"")
+         + pw.enc_bytes(4, np.int32(v).tobytes()))
+    return pw.enc_bytes(8, t)
+
+
+def attr_int(v):
+    """integer AttrValue payload (field 3 = i)."""
+    return pw.enc_varint(3, int(v))
+
+
+def attr_type(v):
+    """type-enum AttrValue payload (field 6 = type)."""
+    return pw.enc_varint(6, int(v))
+
+
 def enter(name, inputs, frame):
     """Enter node with a frame_name attr (while-loop fixtures)."""
     body = pw.enc_str(1, name) + pw.enc_str(2, "Enter")
