@@ -118,17 +118,19 @@ class TFSession:
         stop = False
         for epoch in range(epochs):
             for feeds in self.pipeline.batches(epochs=1, seed=epoch):
+                # pre-step check, like LocalOptimizer: max_epoch(N)
+                # stops before the first step of epoch N, not after it
+                if end_when is not None and end_when(
+                        {"neval": it, "epoch": epoch,
+                         "loss": losses[-1] if losses else float("inf")}):
+                    stop = True
+                    break
                 feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
                 lr = method.current_lr(it, epoch)
                 params, ostate, loss = step(params, ostate, feeds,
                                             np.float32(lr), it)
                 losses.append(float(loss))
                 it += 1
-                if end_when is not None and end_when(
-                        {"neval": it, "epoch": epoch,
-                         "score": losses[-1]}):
-                    stop = True
-                    break
             if stop:
                 break
         m._params = params

@@ -223,9 +223,16 @@ class QueuePipeline:
         return elements
 
     def batches(self, epochs: int = 1, seed: int = 0,
-                drop_remainder: bool = True):
-        """Yield feed dicts {f"{dequeue}:{i}": batched array}."""
+                drop_remainder: Optional[bool] = None):
+        """Yield feed dicts {f"{dequeue}:{i}": batched array}.
+
+        ``drop_remainder`` defaults to the dequeue op's TF semantics:
+        DequeueMany only pops full batches (tail dropped), DequeueUpTo
+        allows a final partial batch."""
+        if drop_remainder is None:
+            drop_remainder = "UpTo" not in self.by_name[self.dequeue]["op"]
         rng = np.random.default_rng(seed)
+        n_yielded = 0
         for _ in range(epochs):
             elements = list(self._decoded_elements())
             if self.shuffle:
@@ -243,4 +250,11 @@ class QueuePipeline:
                     col = np.stack([e[ci] for e in chunk])
                     # a non-Many dequeue pops ONE element, unbatched
                     feeds[f"{self.dequeue}:{ci}"] = col if many else col[0]
+                n_yielded += 1
                 yield feeds
+        if n_yielded == 0:
+            raise ValueError(
+                f"queue pipeline produced 0 batches: "
+                f"{len(self._decoded_elements())} element(s) < batch size "
+                f"{self.batch_size} (DequeueMany drops partial batches; "
+                "use QueueDequeueUpToV2 or more data)")
