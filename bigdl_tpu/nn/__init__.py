@@ -50,6 +50,7 @@ from bigdl_tpu.nn.criterion import (
     TransformerCriterion, CategoricalCrossEntropy,
 )
 from bigdl_tpu.nn.graph import Graph, DynamicGraph, Input, Node
+from bigdl_tpu.nn.control_flow import Cond, Merge, Switch, While
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole,
     ConvLSTMPeephole3D, MultiRNNCell,

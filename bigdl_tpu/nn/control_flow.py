@@ -1,0 +1,225 @@
+"""User-facing control-flow modules for hand-built graphs.
+
+Reference: ``DynamicGraph`` + ``Scheduler.scala:104-145`` — the
+reference executes Enter/Exit/Switch/Merge control-flow NODES with a
+scheduler that propagates "dead" tokens through untaken branches.
+
+TPU redesign: under XLA, control flow must be part of the compiled
+program, so the scheduler's roles map onto three constructs:
+
+- :class:`While` — a loop frame (Enter/Merge/LoopCond/NextIteration/
+  Exit collapses into one module).  With ``max_trip_count`` it compiles
+  to a bounded ``lax.scan`` whose post-exit iterations are skipped via
+  ``lax.cond`` — data-dependent exit AND reverse-mode differentiable,
+  so loop graphs TRAIN (the reference's dynamic graphs cannot generate
+  a backward graph through control flow at all,
+  ``DynamicGraph.scala backwardExecution``); without it, a
+  ``lax.while_loop`` (forward-only, a JAX fundamental).
+- :class:`Cond` — branching via ``lax.cond`` (one branch executes;
+  differentiable).
+- :class:`Switch` / :class:`Merge` — the reference's port semantics as
+  dataflow: both branch subgraphs compute and Merge SELECTS (dead-token
+  propagation becomes ``jnp.where``, which is how the TF importer
+  compiles the same ops, ``interop/tf_format.py`` _exec_switch/_merge).
+
+All three are ordinary :class:`Module`s: use them as ``Graph`` nodes or
+inside ``Sequential``.  ``rng`` is forwarded to children (per-iteration
+``fold_in`` inside loops), and Module predicates/conditions run with
+the caller's ``training`` flag, their state threaded like any child's.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+def _as_pred(v):
+    return jnp.reshape(jnp.asarray(v, bool), ())
+
+
+class While(Module):
+    """``while cond(carry): carry = body(carry)`` as a module.
+
+    - ``cond``: callable ``carry -> bool scalar`` or a Module (applied
+      with the caller's ``training`` flag; its state is threaded
+      through the loop like the body's);
+    - ``body``: Module mapping carry -> carry (same pytree structure
+      and shapes — XLA loops are shape-invariant);
+    - ``max_trip_count``: when given, the loop runs as a bounded
+      ``lax.scan`` where iterations past the exit condition SKIP the
+      body via ``lax.cond`` (not just mask its output — a diverging
+      body after exit would otherwise poison gradients with inf/NaN
+      through the select).  This is the differentiable form — use it
+      for training.  When None, a ``lax.while_loop`` executes exactly
+      like the reference's frame scheduler (forward-only).
+    """
+
+    def __init__(self, cond: Union[Callable, Module], body: Module,
+                 max_trip_count: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name or "While")
+        self.cond = cond
+        self.body = body
+        self.max_trip_count = max_trip_count
+
+    def spec_children(self):
+        out = {"body": self.body}
+        if isinstance(self.cond, Module):
+            out["cond"] = self.cond
+        return out
+
+    def init(self, rng):
+        params, state = {}, {}
+        k1, k2 = jax.random.split(rng)
+        params["body"], state["body"] = self.body.init(k1)
+        if isinstance(self.cond, Module):
+            params["cond"], state["cond"] = self.cond.init(k2)
+        return params, state
+
+    def _cond_value(self, params, cstate, carry, training):
+        if isinstance(self.cond, Module):
+            out, cstate = self.cond.apply(params.get("cond", {}), cstate,
+                                          carry, training=training)
+            return _as_pred(out), cstate
+        return _as_pred(self.cond(carry)), cstate
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        body_state = state.get("body", {})
+        cond_state = state.get("cond", {})
+        it0 = jnp.zeros((), jnp.int32)
+
+        def run_body(carry, bst, it):
+            r = None if rng is None else jax.random.fold_in(rng, it)
+            return self.body.apply(params["body"], bst, carry,
+                                   training=training, rng=r)
+
+        if self.max_trip_count is None:
+            def cond_fn(c):
+                carry, bst, cst, it = c
+                live, _ = self._cond_value(params, cst, carry, training)
+                return live
+
+            def body_fn(c):
+                carry, bst, cst, it = c
+                _, cst = self._cond_value(params, cst, carry, training)
+                out, bst = run_body(carry, bst, it)
+                return (out, bst, cst, it + 1)
+
+            carry, body_state, cond_state, _ = lax.while_loop(
+                cond_fn, body_fn, (input, body_state, cond_state, it0))
+        else:
+            # bounded loop: live iterations run the body, dead ones are
+            # skipped entirely (lax.cond) — differentiable end to end
+            def scan_body(c, _):
+                carry, bst, cst, it = c
+                live, cst = self._cond_value(params, cst, carry, training)
+
+                def taken(operand):
+                    carry, bst, it = operand
+                    out, bst = run_body(carry, bst, it)
+                    return out, bst
+
+                def skipped(operand):
+                    carry, bst, it = operand
+                    return carry, bst
+
+                out, bst = lax.cond(live, taken, skipped,
+                                    (carry, bst, it))
+                return (out, bst, cst, it + 1), None
+
+            (carry, body_state, cond_state, _), _ = lax.scan(
+                scan_body, (input, body_state, cond_state, it0), None,
+                length=self.max_trip_count)
+
+        new_state = dict(state)
+        new_state["body"] = body_state
+        if isinstance(self.cond, Module):
+            new_state["cond"] = cond_state
+        return carry, new_state
+
+
+class Cond(Module):
+    """``true_branch(input) if pred(input) else false_branch(input)``
+    via ``lax.cond`` — only the taken branch executes; both branches
+    must produce the same output structure/shapes."""
+
+    def __init__(self, pred: Union[Callable, Module], true_branch: Module,
+                 false_branch: Module, name: Optional[str] = None):
+        super().__init__(name or "Cond")
+        self.pred = pred
+        self.true_branch = true_branch
+        self.false_branch = false_branch
+
+    def spec_children(self):
+        out = {"true": self.true_branch, "false": self.false_branch}
+        if isinstance(self.pred, Module):
+            out["pred"] = self.pred
+        return out
+
+    def init(self, rng):
+        params, state = {}, {}
+        k1, k2, k3 = jax.random.split(rng, 3)
+        params["true"], state["true"] = self.true_branch.init(k1)
+        params["false"], state["false"] = self.false_branch.init(k2)
+        if isinstance(self.pred, Module):
+            params["pred"], state["pred"] = self.pred.init(k3)
+        return params, state
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        new_state = dict(state)
+        if isinstance(self.pred, Module):
+            pv, pstate = self.pred.apply(params["pred"],
+                                         state.get("pred", {}), input,
+                                         training=training)
+            new_state["pred"] = pstate
+        else:
+            pv = self.pred(input)
+        pv = _as_pred(pv)
+        kt, kf = (None, None) if rng is None else jax.random.split(rng)
+
+        def true_fn(x):
+            out, st = self.true_branch.apply(
+                params["true"], state["true"], x, training=training,
+                rng=kt)
+            return out, st, state["false"]
+
+        def false_fn(x):
+            out, st = self.false_branch.apply(
+                params["false"], state["false"], x, training=training,
+                rng=kf)
+            return out, state["true"], st
+
+        out, t_state, f_state = lax.cond(pv, true_fn, false_fn, input)
+        new_state["true"], new_state["false"] = t_state, f_state
+        return out, new_state
+
+
+class Switch(Module):
+    """Reference ``Switch`` port semantics as dataflow: input
+    ``(data, pred)`` → output ``(data_port0, data_port1)`` feeding the
+    false/true subgraphs.  Under XLA both branch subgraphs compute (no
+    dead tokens); pair with :class:`Merge` which performs the select —
+    the same compilation the TF importer applies to imported
+    Switch/Merge nodes."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        data, pred = input
+        return (data, data), state
+
+
+class Merge(Module):
+    """Reference ``Merge``: pick the live branch.  Input
+    ``(false_val, true_val, pred)`` → ``where(pred, true_val,
+    false_val)`` (elementwise select replaces dead-token scheduling)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        false_val, true_val, pred = input
+        pred = _as_pred(pred)
+        return jax.tree_util.tree_map(
+            lambda t, f: jnp.where(pred, t, f), true_val, false_val), state

@@ -158,18 +158,22 @@ class Graph(Module):
 
 
 class DynamicGraph(Graph):
-    """Name-parity alias of :class:`Graph` (reference ``DynamicGraph.scala``
-    + ``Scheduler.scala:104-145``).
+    """Graph whose nodes may be control-flow modules (reference
+    ``DynamicGraph.scala`` + ``Scheduler.scala:104-145``).
 
     The reference needs a separate dynamic graph executor because its
     static graph precomputes a topological order that cannot express
     data-dependent control flow; the ``Scheduler`` then interprets
     Enter/Exit/Switch/Merge frames node-by-node with dead-token
-    propagation.  Under XLA that split disappears: data-dependent control
-    flow lives INSIDE compiled nodes as ``lax.cond`` / ``lax.while_loop``
-    (wrap them in :class:`~bigdl_tpu.nn.module.Lambda` or custom modules),
-    and imported TF control flow is compiled the same way by
-    ``interop.tf_format`` (Switch/Merge → select, loop frames →
-    ``lax.while_loop``).  This subclass exists so reference-named code
-    ports cleanly; behavior is identical to :class:`Graph`.
+    propagation.  Under XLA that split disappears: data-dependent
+    control flow lives INSIDE compiled nodes —
+    :class:`~bigdl_tpu.nn.control_flow.While` (a whole loop frame, as a
+    bounded masked scan it even TRAINS, which the reference's dynamic
+    graphs cannot), :class:`~bigdl_tpu.nn.control_flow.Cond`, and the
+    port-semantic :class:`~bigdl_tpu.nn.control_flow.Switch` /
+    :class:`~bigdl_tpu.nn.control_flow.Merge` pair — so the scheduler's
+    graph-level role reduces to the same topological execution
+    :class:`Graph` already performs.  Imported TF control flow compiles
+    identically (``interop.tf_format``: Switch/Merge → select, loop
+    frames → ``lax.while_loop``/``lax.scan``).
     """

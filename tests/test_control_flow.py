@@ -1,0 +1,192 @@
+"""User-facing control-flow modules (VERDICT r3 item 6): loop/cond/
+switch-merge graphs built via the nn API — NOT the TF importer — that
+execute and TRAIN (reference Scheduler.scala:104-145)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn, optim
+
+
+def rng(i):
+    return jax.random.PRNGKey(i)
+
+
+class TestWhile:
+    def test_unbounded_while_matches_python(self):
+        # carry = (i, x); while i < 5: x = 2x + 1, i += 1
+        body = nn.Lambda(lambda c: (c[0] + 1, 2.0 * c[1] + 1.0))
+        w = nn.While(lambda c: c[0] < 5, body)
+        p, s = w.init(rng(0))
+        out, _ = w.apply(p, s, (jnp.asarray(0), jnp.asarray(1.0)))
+        x = 1.0
+        for _ in range(5):
+            x = 2 * x + 1
+        assert float(out[1]) == x and int(out[0]) == 5
+
+    def test_bounded_while_masks_after_exit(self):
+        body = nn.Lambda(lambda c: (c[0] + 1, c[1] * 2.0))
+        w = nn.While(lambda c: c[0] < 3, body, max_trip_count=10)
+        p, s = w.init(rng(0))
+        out, _ = w.apply(p, s, (jnp.asarray(0), jnp.asarray(1.0)))
+        assert int(out[0]) == 3 and float(out[1]) == 8.0  # not 2**10
+
+    def test_loop_graph_trains(self):
+        """The verdict's 'Done' case: a loop graph built via the nn
+        API trains through the bounded While."""
+        steps = 4
+
+        class Step(nn.Module):
+            def __init__(self):
+                super().__init__("Step")
+                self.lin = nn.Linear(6, 6)
+
+            def spec_children(self):
+                return {"lin": self.lin}
+
+            def init(self, r):
+                p, s = self.lin.init(r)
+                return {"lin": p}, {"lin": s}
+
+            def apply(self, params, state, c, *, training=False, rng=None):
+                i, h = c
+                y, _ = self.lin.apply(params["lin"], state["lin"], h)
+                return (i + 1, jnp.tanh(y)), state
+
+        loop = nn.While(lambda c: c[0] < steps, Step(),
+                        max_trip_count=8)
+        inp = nn.Input()
+        looped = loop(inp)
+        head = nn.Lambda(lambda c: c[1])(looped)
+        out = nn.Linear(6, 2)(head)
+        model = nn.DynamicGraph([inp], [nn.LogSoftMax()(out)])
+
+        p, st = model.init(rng(0))
+        method = optim.Adam(learning_rate=0.01)
+        os_ = method.init_state(p)
+        crit = nn.ClassNLLCriterion()
+        data_rng = np.random.default_rng(0)
+        x = data_rng.normal(0, 1, (64, 6)).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int32)
+        x, y = jnp.asarray(x), jnp.asarray(y)
+
+        @jax.jit
+        def step(p, os_, it):
+            def loss_fn(p):
+                outv, _ = model.apply(
+                    p, st, (jnp.zeros((), jnp.int32) + 0, x))
+                return crit.apply(outv, y)
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            p, os_ = method.update(g, p, os_, 0.01, it)
+            return p, os_, loss
+
+        losses = []
+        for it in range(80):
+            p, os_, loss = step(p, os_, it)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+    def test_cond_module_as_predicate(self):
+        pred = nn.Lambda(lambda c: c[0] < 2)
+        body = nn.Lambda(lambda c: (c[0] + 1, c[1] + 10.0))
+        w = nn.While(pred, body)
+        p, s = w.init(rng(0))
+        out, _ = w.apply(p, s, (jnp.asarray(0), jnp.asarray(0.0)))
+        assert float(out[1]) == 20.0
+
+
+class TestWhileRobustness:
+    def test_dead_iterations_do_not_poison_gradients(self):
+        """A body that diverges past the exit point must not leak
+        inf/NaN into gradients: dead iterations are SKIPPED, not
+        masked."""
+        body = nn.Lambda(lambda c: (c[0] + 1, c[1] * 50.0))
+        w = nn.While(lambda c: c[0] < 3, body, max_trip_count=60)
+        p, s = w.init(rng(0))
+
+        def loss_fn(x):
+            out, _ = w.apply(p, s, (jnp.asarray(0), x))
+            return out[1]
+
+        g = jax.grad(loss_fn)(jnp.asarray(1.0))
+        assert np.isfinite(float(g))
+        assert float(g) == 50.0 ** 3
+
+    def test_dropout_inside_while_body(self):
+        body = nn.Sequential().add(nn.Dropout(0.5)) \
+            .add(nn.Lambda(lambda x: x))
+        carry_body = nn.Lambda(lambda c: c)  # wrap: carry = (i, x)
+
+        class B(nn.Module):
+            def __init__(self):
+                super().__init__("B")
+                self.inner = body
+
+            def spec_children(self):
+                return {"inner": self.inner}
+
+            def init(self, r):
+                p, s = self.inner.init(r)
+                return {"inner": p}, {"inner": s}
+
+            def apply(self, params, state, c, *, training=False,
+                      rng=None):
+                i, x = c
+                y, _ = self.inner.apply(params["inner"], state["inner"],
+                                        x, training=training, rng=rng)
+                return (i + 1, y), state
+
+        w = nn.While(lambda c: c[0] < 2, B(), max_trip_count=4)
+        p, s = w.init(rng(0))
+        out, _ = w.apply(p, s, (jnp.asarray(0), jnp.ones((8,))),
+                         training=True, rng=rng(1))
+        assert out[1].shape == (8,)  # no "needs an rng" error
+
+
+class TestCond:
+    def test_branch_selection(self):
+        c = nn.Cond(lambda x: jnp.sum(x) > 0,
+                    nn.Lambda(lambda x: x * 2.0),
+                    nn.Lambda(lambda x: x - 1.0))
+        p, s = c.init(rng(0))
+        out, _ = c.apply(p, s, jnp.asarray([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 4.0])
+        out, _ = c.apply(p, s, jnp.asarray([-1.0, -2.0]))
+        np.testing.assert_allclose(np.asarray(out), [-2.0, -3.0])
+
+    def test_cond_trains_both_branches(self):
+        model = nn.Cond(lambda x: jnp.mean(x) > 0,
+                        nn.Linear(4, 3), nn.Linear(4, 3))
+        p, st = model.init(rng(0))
+
+        def loss_fn(p, x):
+            out, _ = model.apply(p, st, x)
+            return jnp.sum(out ** 2)
+
+        xpos = jnp.ones((4,))
+        g = jax.grad(loss_fn)(p, xpos)
+        # taken branch gets gradient, untaken gets zeros
+        assert float(jnp.abs(g["true"]["weight"]).sum()) > 0
+        assert float(jnp.abs(g["false"]["weight"]).sum()) == 0
+
+
+class TestSwitchMerge:
+    def test_piecewise_graph(self):
+        """Hand-built Switch/Merge graph: relu-like piecewise select,
+        the reference's port semantics compiled to a select."""
+        data = nn.Input()
+        pred = nn.Input()
+        sw = nn.Switch()
+        ports = sw((data, pred))
+        f_br = nn.Lambda(lambda t: t[0] * 0.1)(ports)   # port 0: false
+        t_br = nn.Lambda(lambda t: t[1])(ports)         # port 1: true
+        merged = nn.Merge()((f_br, t_br, pred))
+        g = nn.DynamicGraph([data, pred], [merged])
+        p, s = g.init(rng(0))
+        x = jnp.asarray([-2.0, 3.0])
+        out_t, _ = g.apply(p, s, (x, jnp.asarray(True)))
+        out_f, _ = g.apply(p, s, (x, jnp.asarray(False)))
+        np.testing.assert_allclose(np.asarray(out_t), [-2.0, 3.0])
+        np.testing.assert_allclose(np.asarray(out_f), [-0.2, 0.3],
+                                   rtol=1e-6)
