@@ -21,3 +21,8 @@ from bigdl_tpu.dataset import seqfile
 from bigdl_tpu.dataset import movielens
 from bigdl_tpu.dataset import news20
 from bigdl_tpu.dataset.prefetch import MTSampleToMiniBatch
+from bigdl_tpu.dataset.datamining import (
+    BucketizedCol, CategoricalColHashBucket, CategoricalColVocaList,
+    ColToSchema, ColToTensor, ColsToNumeric, CrossCol, IndicatorCol,
+    RowTransformer, RowTransformSchema,
+)

@@ -100,25 +100,36 @@ class While(Module):
                                    training=training, rng=r)
 
         if self.max_trip_count is None:
+            # liveness rides the carry so the predicate runs exactly
+            # once per trip (a cond_fn predicate would be re-evaluated
+            # on top of the state-threading evaluation in the body)
+            live0, cond_state = self._cond_value(params, cond_state,
+                                                 input, training)
+
             def cond_fn(c):
-                carry, bst, cst, it = c
-                live, _ = self._cond_value(params, cst, carry, training)
-                return live
+                return c[4]
 
             def body_fn(c):
-                carry, bst, cst, it = c
-                _, cst = self._cond_value(params, cst, carry, training)
+                carry, bst, cst, it, _ = c
                 out, bst = run_body(carry, bst, it)
-                return (out, bst, cst, it + 1)
+                live, cst = self._cond_value(params, cst, out, training)
+                return (out, bst, cst, it + 1, live)
 
-            carry, body_state, cond_state, _ = lax.while_loop(
-                cond_fn, body_fn, (input, body_state, cond_state, it0))
+            carry, body_state, cond_state, _, _ = lax.while_loop(
+                cond_fn, body_fn,
+                (input, body_state, cond_state, it0, live0))
         else:
             # bounded loop: live iterations run the body, dead ones are
-            # skipped entirely (lax.cond) — differentiable end to end
+            # skipped entirely (lax.cond) — differentiable end to end.
+            # The predicate's state also freezes once the loop is dead,
+            # matching the unbounded path's per-trip semantics.
             def scan_body(c, _):
                 carry, bst, cst, it = c
-                live, cst = self._cond_value(params, cst, carry, training)
+                live, cst_new = self._cond_value(params, cst, carry,
+                                                 training)
+                cst = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(live, new, old),
+                    cst_new, cst)
 
                 def taken(operand):
                     carry, bst, it = operand

@@ -1,0 +1,163 @@
+"""RowTransformer + feature columns (VERDICT r3 item 7; reference
+RowTransformer.scala and nn/ops feature-column ops)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.dataset import (BucketizedCol, CategoricalColHashBucket,
+                               CategoricalColVocaList, ColsToNumeric,
+                               ColToTensor, CrossCol, IndicatorCol,
+                               RowTransformer)
+from bigdl_tpu.nn.sparse import COOBatch
+
+
+class TestRowTransformer:
+    ROWS = [("alice", "engineer", 34.0, 1.5),
+            ("bob", "teacher", 28.0, -0.5)]
+    FIELDS = ["name", "job", "age", "score"]
+
+    def test_atomic(self):
+        t = RowTransformer.atomic(self.FIELDS)
+        out = list(t(iter(self.ROWS)))
+        assert out[0]["name"] == "alice"
+        assert float(out[1]["age"]) == 28.0
+
+    def test_numeric_group(self):
+        t = RowTransformer.numeric("feats", ["age", "score"])
+        t.field_names = self.FIELDS
+        out = list(t(iter(self.ROWS)))
+        np.testing.assert_allclose(out[0]["feats"], [34.0, 1.5])
+
+    def test_mixed_schemas_and_dict_rows(self):
+        t = RowTransformer([ColToTensor("who", "name"),
+                            ColsToNumeric("x", ["age", "score"])])
+        row = dict(zip(self.FIELDS, self.ROWS[0]))
+        out = t.transform_row(row)
+        assert out["who"] == "alice"
+        np.testing.assert_allclose(out["x"], [34.0, 1.5])
+
+    def test_duplicate_keys_rejected(self):
+        with pytest.raises(ValueError):
+            RowTransformer([ColToTensor("k", "a"), ColToTensor("k", "b")])
+
+
+class TestFeatureColumns:
+    def test_bucketized_col_reference_example(self):
+        # reference BucketizedCol doc example: boundaries [0, 10, 100]
+        b = BucketizedCol([0, 10, 100])
+        x = np.asarray([[-1, 1], [101, 10], [5, 100]], np.float64)
+        np.testing.assert_array_equal(b(x), [[0, 1], [3, 2], [1, 3]])
+
+    def test_hash_bucket_deterministic_and_in_range(self):
+        h = CategoricalColHashBucket(hash_bucket_size=10)
+        coo = h(["a,b", "c", ""])
+        assert isinstance(coo, COOBatch)
+        assert coo.dense_shape == (3, 10)
+        dense = np.asarray(coo.to_dense())
+        assert dense[0].sum() == 2 and dense[1].sum() == 1
+        assert dense[2].sum() == 0  # missing value -> no ids
+        coo2 = h(["a,b", "c", ""])
+        np.testing.assert_array_equal(np.asarray(coo.col),
+                                      np.asarray(coo2.col))
+
+    def test_voca_list_oov_modes(self):
+        v = CategoricalColVocaList(["cat", "dog"])
+        assert np.asarray(v(["cat,hamster"]).to_dense()).sum() == 1  # dropped
+        vd = CategoricalColVocaList(["cat", "dog"], is_set_default=True)
+        d = np.asarray(vd(["hamster"]).to_dense())
+        assert d[0, 2] == 1  # default id = len(vocab)
+        vo = CategoricalColVocaList(["cat", "dog"], num_oov_buckets=3)
+        d = np.asarray(vo(["hamster"]).to_dense())
+        assert d.shape == (1, 5) and d[0, 2:].sum() == 1
+        with pytest.raises(ValueError):
+            CategoricalColVocaList(["x"], is_set_default=True,
+                                   num_oov_buckets=2)
+
+    def test_cross_col_cartesian(self):
+        c = CrossCol(hash_bucket_size=50)
+        coo = c([["A,D", "B", "A,C"], ["1", "2", "3,4"]])
+        dense = np.asarray(coo.to_dense())
+        # row 0: 2x1 combos, row 1: 1, row 2: 2x2 (reference doc example)
+        assert dense[0].sum() == 2
+        assert dense[1].sum() == 1
+        assert dense[2].sum() == 4
+
+    def test_indicator_col_count_semantics(self):
+        coo = COOBatch(jnp.asarray([0, 0, 1, 2, 2], jnp.int32),
+                       jnp.asarray([1, 2, 2, 3, 3], jnp.int32),
+                       jnp.ones(5), (3, 4))
+        ind = IndicatorCol(4)(coo)
+        np.testing.assert_array_equal(
+            ind, [[0, 1, 1, 0], [0, 0, 1, 0], [0, 0, 0, 2]])
+        ind01 = IndicatorCol(4, is_count=False)(coo)
+        assert ind01[2, 3] == 1.0
+
+
+class TestWideDeepFromCSV:
+    """The verdict's 'Done' case: Wide&Deep ingests a CSV-like table
+    through RowTransformer + feature columns."""
+
+    def test_csv_to_training(self):
+        rng = np.random.default_rng(0)
+        jobs = ["eng", "doc", "art", "law"]
+        cities = ["nyc", "sfo", "chi"]
+        rows = []
+        for _ in range(256):
+            j = jobs[rng.integers(0, 4)]
+            c = cities[rng.integers(0, 3)]
+            age = float(rng.integers(20, 70))
+            # structured label: depends on the (job, city) cross
+            label = 1.0 if (j in ("eng", "doc")) == (c == "nyc") else 0.0
+            rows.append((j, c, age, label))
+
+        rt = RowTransformer.atomic(["job", "city", "age", "label"])
+        cols = {k: [r[k] for r in rt(iter(rows))]
+                for k in ("job", "city", "age", "label")}
+
+        job_col = CategoricalColVocaList(jobs)
+        # 1024 buckets: with fewer, birthday collisions among the 12
+        # true (job, city) crosses merge opposite-label combos and cap
+        # the attainable accuracy (at 256, two such collisions occur)
+        cross = CrossCol(hash_bucket_size=1024)
+        bucket = BucketizedCol([30, 40, 50, 60])
+
+        wide_join = nn.SparseJoinTable([len(jobs), 1024])
+        coo_job = job_col(cols["job"])
+        coo_cross = cross([cols["job"], cols["city"]])
+        wide, _ = wide_join.apply({}, {}, [coo_job, coo_cross])
+
+        deep_ids = np.stack([bucket(cols["age"])], 1).astype(np.int32)
+        dense = (np.asarray(cols["age"], np.float32)[:, None] - 40.0) / 20.0
+        y = jnp.asarray(np.asarray(cols["label"], np.float32))
+
+        from bigdl_tpu import models
+        model = models.WideAndDeep(len(jobs) + 1024, [5], 1, embed_dim=4,
+                                   hidden=(16,))
+        p, st = model.init(jax.random.PRNGKey(0))
+        method = optim.Adam(learning_rate=0.03)
+        os_ = method.init_state(p)
+        crit = nn.BCECriterion()
+
+        @jax.jit
+        def step(p, os_, it):
+            def loss_fn(p):
+                out, _ = model.apply(
+                    p, st, (wide, jnp.asarray(deep_ids),
+                            jnp.asarray(dense)))
+                return crit.apply(out[:, 0], y)
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            p, os_ = method.update(g, p, os_, 0.03, it)
+            return p, os_, loss
+
+        losses = []
+        for it in range(400):
+            p, os_, loss = step(p, os_, it)
+            losses.append(float(loss))
+        assert losses[-1] < 0.25, (losses[0], losses[-1])
+        out, _ = model.apply(p, st, (wide, jnp.asarray(deep_ids),
+                                     jnp.asarray(dense)))
+        acc = float(((np.asarray(out)[:, 0] > 0.5) ==
+                     (np.asarray(y) > 0.5)).mean())
+        assert acc > 0.9, acc
