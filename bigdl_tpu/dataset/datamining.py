@@ -173,6 +173,23 @@ class BucketizedCol:
                                side="right").astype(np.int32)
 
 
+def _to_coo(rows, cols, n, n_ids):
+    """Assemble a COOBatch from accumulated (row, col) id pairs; a
+    NON-empty batch with no ids keeps one zero-valued placeholder entry
+    so the stream stays XLA-friendly (an EMPTY batch keeps empty
+    arrays — row 0 wouldn't exist)."""
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.sparse import COOBatch
+    if not rows and n > 0:
+        rows, cols, vals = [0], [0], [0.0]
+    else:
+        vals = [1.0] * len(rows)
+    return COOBatch(jnp.asarray(np.asarray(rows, np.int32)),
+                    jnp.asarray(np.asarray(cols, np.int32)),
+                    jnp.asarray(np.asarray(vals, np.float32)),
+                    (n, n_ids))
+
+
 class _CategoricalBase:
     """Shared string → id-list machinery; subclasses map one string
     token to an id (or None to drop)."""
@@ -197,24 +214,12 @@ class _CategoricalBase:
         """batch of strings → COOBatch (row, col=id, value=1) of shape
         (N, n_ids) — directly consumable by SparseLinear /
         LookupTableSparse / IndicatorCol."""
-        import jax.numpy as jnp
-        from bigdl_tpu.nn.sparse import COOBatch
         rows, cols = [], []
         for r, s in enumerate(column):
             for i in self.row_ids(s):
                 rows.append(r)
                 cols.append(i)
-        n = len(column)
-        if not rows and n > 0:
-            # keep a non-empty (but zero-valued) stream for XLA; an
-            # EMPTY batch keeps empty arrays (row 0 wouldn't exist)
-            rows, cols, vals = [0], [0], [0.0]
-        else:
-            vals = [1.0] * len(rows)
-        return COOBatch(jnp.asarray(np.asarray(rows, np.int32)),
-                        jnp.asarray(np.asarray(cols, np.int32)),
-                        jnp.asarray(np.asarray(vals, np.float32)),
-                        (n, self.n_ids))
+        return _to_coo(rows, cols, len(column), self.n_ids)
 
 
 class CategoricalColHashBucket(_CategoricalBase):
@@ -273,8 +278,6 @@ class CrossCol:
         self.delimiter = delimiter
 
     def __call__(self, columns: Sequence[Sequence]):
-        import jax.numpy as jnp
-        from bigdl_tpu.nn.sparse import COOBatch
         if len(columns) < 2:
             raise ValueError("CrossCol needs at least 2 columns")
         n = len(columns[0])
@@ -288,14 +291,7 @@ class CrossCol:
             for c in combos:
                 rows.append(r)
                 cols.append(_hash_bucket(c, self.n_ids))
-        if not rows and n > 0:
-            rows, cols, vals = [0], [0], [0.0]
-        else:
-            vals = [1.0] * len(rows)
-        return COOBatch(jnp.asarray(np.asarray(rows, np.int32)),
-                        jnp.asarray(np.asarray(cols, np.int32)),
-                        jnp.asarray(np.asarray(vals, np.float32)),
-                        (n, self.n_ids))
+        return _to_coo(rows, cols, n, self.n_ids)
 
 
 class IndicatorCol:

@@ -22,6 +22,7 @@ from bigdl_tpu.keras.layers import (
     MaxoutDense, SeparableConvolution2D, Merge,
 )
 from bigdl_tpu.keras.topology import Sequential, Model
+from bigdl_tpu.keras.backend import KerasModelWrapper, load_model
 
 __all__ = [
     "KerasLayer", "Dense", "Activation", "Dropout", "Flatten", "Reshape",
@@ -34,4 +35,5 @@ __all__ = [
     "GlobalAveragePooling1D", "Highway", "MaxoutDense",
     "SeparableConvolution2D", "Merge",
     "Sequential", "Model",
+    "KerasModelWrapper", "load_model",
 ]

@@ -1,0 +1,85 @@
+"""One-call Keras-model surface over import + training.
+
+Reference: ``pyspark/bigdl/keras/backend.py`` ``KerasModelWrapper`` —
+wrap a (compiled) Keras model so fit/evaluate/predict run on the BigDL
+backend in one object, converting the Keras loss/optimizer/metrics.
+
+Here the wrapper glues the Keras-1.2 importer
+(``interop.keras_format``: JSON definition + HDF5 weights) to the
+Keras-style topology's compile/fit/evaluate/predict
+(``keras.topology``), so a model exported from Keras trains/serves
+with one construction call::
+
+    m = KerasModelWrapper("model.json", "weights.h5",
+                          optimizer="adam", loss="categorical_crossentropy")
+    m.fit(x, y, nb_epoch=2)
+    m.evaluate(x, y)
+    m.predict(x)
+
+Loss/optimizer/metrics accept the same string names as
+``keras.topology.compile`` (the reference's ``OptimConverter`` role);
+without a ``loss`` the model is import-only until :meth:`compile`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+
+class KerasModelWrapper:
+    """(reference ``KerasModelWrapper``) import + train/evaluate/predict
+    in one object."""
+
+    def __init__(self, json_path: str, hdf5_path: Optional[str] = None,
+                 optimizer: Union[str, object] = "sgd",
+                 loss: Union[str, object, None] = None,
+                 metrics: Optional[Sequence] = None):
+        from bigdl_tpu.interop.keras_format import (load_keras_hdf5_weights,
+                                                    load_keras_json)
+        self.bmodel = load_keras_json(json_path)
+        if hdf5_path is not None:
+            load_keras_hdf5_weights(self.bmodel, hdf5_path)
+        if loss is not None:
+            self.bmodel.compile(optimizer, loss, metrics)
+
+    # ------------------------------------------------------ delegation
+    def compile(self, optimizer, loss, metrics=None) -> "KerasModelWrapper":
+        self.bmodel.compile(optimizer, loss, metrics)
+        return self
+
+    def fit(self, x, y, batch_size: int = 32, nb_epoch: int = 10,
+            validation_data=None, distributed: bool = False
+            ) -> "KerasModelWrapper":
+        if y is None:
+            raise ValueError("fit() needs labels y (the reference's "
+                             "y=None form is its RDD[Sample] path, which "
+                             "has no equivalent here)")
+        self.bmodel.fit(x, y, batch_size=batch_size, nb_epoch=nb_epoch,
+                        validation_data=validation_data,
+                        distributed=distributed)
+        return self
+
+    def evaluate(self, x, y, batch_size: int = 32) -> dict:
+        return self.bmodel.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        return self.bmodel.predict(x, batch_size=batch_size)
+
+    def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
+        return self.bmodel.predict_classes(x, batch_size=batch_size)
+
+    def set_weights(self, weights) -> "KerasModelWrapper":
+        """Install a flat Keras-order weight list (each layer's
+        ``get_weights()`` concatenated)."""
+        from bigdl_tpu.interop.keras_format import set_keras_weights
+        set_keras_weights(self.bmodel, list(weights))
+        return self
+
+
+def load_model(json_path: str, hdf5_path: Optional[str] = None,
+               **compile_kw) -> KerasModelWrapper:
+    """Convenience constructor mirroring the reference's
+    ``with_bigdl_backend`` role for file-exported models."""
+    return KerasModelWrapper(json_path, hdf5_path, **compile_kw)

@@ -54,12 +54,28 @@ class ValidationMethod:
         return self.name
 
 
+def _as_class_indices(target, output):
+    """Accept class indices (N,), (N,1) column labels, or one-hot
+    (N, C): one-hot only when the class axis matches the output's (a
+    (N,1) index column must NOT be argmax'd — it would collapse every
+    label to 0)."""
+    if target.ndim == output.ndim and \
+            target.shape[-1] == output.shape[-1] and output.shape[-1] > 1:
+        return jnp.argmax(target, axis=-1)
+    if target.ndim == output.ndim and target.shape[-1] == 1:
+        return target[..., 0]
+    return target
+
+
 class Top1Accuracy(ValidationMethod):
-    """(reference ``ValidationMethod.scala:170``)"""
+    """(reference ``ValidationMethod.scala:170``; like the reference it
+    accepts one-hot targets — Keras categorical losses train against
+    one-hot — as well as class indices, including (N,1) columns)"""
     name = "Top1Accuracy"
 
     def batch_stats(self, output, target):
         pred = jnp.argmax(output, axis=-1)
+        target = _as_class_indices(target, output)
         correct = jnp.sum(pred == target.astype(pred.dtype))
         return correct, target.shape[0]
 
@@ -70,6 +86,7 @@ class Top5Accuracy(ValidationMethod):
 
     def batch_stats(self, output, target):
         _, top5 = jax.lax.top_k(output, 5)
+        target = _as_class_indices(target, output)
         hit = jnp.any(top5 == target.astype(top5.dtype)[..., None], axis=-1)
         return jnp.sum(hit), target.shape[0]
 
