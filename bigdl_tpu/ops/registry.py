@@ -724,6 +724,90 @@ def _decode_gif(attrs, contents):
     return np.stack(frames)
 
 
+@register_op("ApproximateEqual")
+def _approximate_equal(attrs, x, y):
+    tol = float(attrs.get("tolerance", 1e-5))
+    return jnp.abs(x - y) < tol
+
+
+@register_op("Dilation2D")
+def _dilation2d(attrs, input, filter):
+    """Grayscale morphological dilation (TF Dilation2D; reference
+    loader ``utils/tf/loaders/Dilation2D``): per channel,
+    out[b,y,x,c] = max_{dy,dx} input[b, y*s+dy*r, x*s+dx*r, c]
+    + filter[dy,dx,c].  NHWC only, like TF."""
+    strides = [int(v) for v in attrs.get("strides", [1, 1, 1, 1])]
+    rates = [int(v) for v in attrs.get("rates", [1, 1, 1, 1])]
+    padding = attrs.get("padding", b"SAME")
+    padding = padding.decode() if isinstance(padding, bytes) else padding
+    N, H, W, C = input.shape
+    KH, KW, _ = filter.shape
+    sh, sw = strides[1], strides[2]
+    rh, rw = rates[1], rates[2]
+    eff_kh, eff_kw = (KH - 1) * rh + 1, (KW - 1) * rw + 1
+    if padding == "SAME":
+        OH, OW = -(-H // sh), -(-W // sw)
+        ph = max((OH - 1) * sh + eff_kh - H, 0)
+        pw = max((OW - 1) * sw + eff_kw - W, 0)
+        pads = ((ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2))
+    else:
+        OH = (H - eff_kh) // sh + 1
+        OW = (W - eff_kw) // sw + 1
+        pads = ((0, 0), (0, 0))
+    xp = jnp.pad(input, ((0, 0), pads[0], pads[1], (0, 0)),
+                 constant_values=-jnp.inf)
+    out = None
+    for dy in range(KH):
+        for dx in range(KW):
+            win = lax.slice(
+                xp, (0, dy * rh, dx * rw, 0),
+                (N, dy * rh + (OH - 1) * sh + 1,
+                 dx * rw + (OW - 1) * sw + 1, C),
+                (1, sh, sw, 1))
+            cand = win + filter[dy, dx]
+            out = cand if out is None else jnp.maximum(out, cand)
+    return out
+
+
+@register_op("RandomShuffle")
+def _random_shuffle(attrs, value):
+    """Shuffle along dim 0 (TF RandomShuffle), seeded from the node's
+    seed attrs + name like the other random ops (``_op_key``)."""
+    return jax.random.permutation(_op_key(attrs), value, axis=0)
+
+
+@register_op("Substr")
+def _substr(attrs, input, pos, length):
+    """Substring of byte strings (TF Substr; host-side, strings never
+    enter device code)."""
+    shape = np.shape(input)
+    flat = np.asarray(input, object).reshape(-1)
+    p = np.broadcast_to(np.asarray(pos), shape).reshape(-1)
+    n = np.broadcast_to(np.asarray(length), shape).reshape(-1)
+    out = []
+    for s, pi, ni in zip(flat, p, n):
+        b = s if isinstance(s, bytes) else str(s).encode()
+        out.append(b[int(pi):int(pi) + int(ni)])
+    return np.asarray(out, object).reshape(np.shape(input))
+
+
+@register_op("Assert")
+def _assert(attrs, condition, *data):
+    """TF Assert: under jit a data-dependent host assert cannot fire;
+    the op is a no-op pass-through (use BIGDL_TPU_DEBUG_NANS for
+    numeric sanitizing).  Eager numpy inputs DO check."""
+    c = np.asarray(condition) if not hasattr(condition, "aval") else None
+    if c is not None and not bool(c.all()):
+        raise AssertionError(
+            f"imported TF Assert failed: {[np.asarray(d) for d in data]}")
+    return condition
+
+
+@register_op("NoOp")
+def _noop(attrs):
+    return ()
+
+
 # --------------------------------------------------------- TensorArray
 # (reference ``DL/nn/tf/DataFlowOps.scala``: TensorArray read/write/
 # gather/scatter used by dynamic-RNN exports.)

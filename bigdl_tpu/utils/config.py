@@ -30,6 +30,10 @@ class Config:
     # numerics
     compute_dtype: str = "float32"     # "bfloat16" flips matmul precision
     matmul_precision: str = "default"  # jax "default"|"high"|"highest"
+    # NaN sanitizer (SURVEY §5: lean on jax.debug_nans instead of the
+    # reference's per-layer checks): opt in via BIGDL_TPU_DEBUG_NANS=1
+    # or configure(debug_nans=True), then call apply_debug_config()
+    debug_nans: bool = False
     # logging / observability
     log_every_n_iterations: int = 1
     summary_flush_secs: float = 10.0
@@ -64,6 +68,10 @@ def get_config() -> Config:
     global _config
     if _config is None:
         _config = Config.from_env()
+        if _config.debug_nans:
+            # BIGDL_TPU_DEBUG_NANS=1 alone must be enough: push the
+            # toggle into jax as soon as the config is first read
+            apply_debug_config(_config)
     return _config
 
 
@@ -75,6 +83,8 @@ def configure(**kw) -> Config:
             raise AttributeError(f"unknown config field {k!r}; fields: "
                                  f"{[f.name for f in dataclasses.fields(Config)]}")
         setattr(cfg, k, v)
+    if "debug_nans" in kw:
+        apply_debug_config(cfg)
     return cfg
 
 
@@ -82,3 +92,13 @@ def reset_config() -> None:
     """Drop overrides; next get_config() re-reads the environment."""
     global _config
     _config = None
+
+
+def apply_debug_config(cfg: Optional[Config] = None) -> None:
+    """Push debug toggles into the jax runtime (the ``debug_nans``
+    sanitizer makes every jit'd computation fail LOUDLY at the first
+    NaN instead of training garbage — the reference's NaN checks are
+    scattered per-layer asserts)."""
+    import jax
+    cfg = cfg or get_config()
+    jax.config.update("jax_debug_nans", bool(cfg.debug_nans))

@@ -3,8 +3,15 @@
 Analog of the reference's Wide&Deep workload (named in BASELINE.json;
 reference-era BigDL serves it via the sparse layer family —
 ``SparseLinear``/``LookupTableSparse``).  Trains on MovieLens-style
-implicit feedback: wide = crossed (user x genre-bucket) id bags through
-SparseLinear, deep = user/item embeddings through an MLP.
+implicit feedback: wide = crossed (user x genre-bucket) sparse
+features through SparseLinear, deep = user/item embeddings through an
+MLP.
+
+Two wide-feature representations (see ``nn/sparse.py``):
+- default: fixed-width id bags (ids + weights arrays);
+- ``--sparse-coo``: ragged per-sample sparse features collated into
+  batch-COO ``SparseMiniBatch``es (the reference's ``SparseMiniBatch``
+  path) executed via segment-sum kernels.
 """
 
 from __future__ import annotations
@@ -26,6 +33,9 @@ def main():
                         "synthetic ratings)")
     p.add_argument("-b", "--batch-size", type=int, default=256)
     p.add_argument("-e", "--max-epoch", type=int, default=8)
+    p.add_argument("--sparse-coo", action="store_true",
+                   help="feed the wide part as batch-COO "
+                        "SparseMiniBatches instead of fixed-width bags")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
 
@@ -81,17 +91,48 @@ def main():
 
     method = optim.Adam(learning_rate=0.01)
     ostate = method.init_state(params)
-    step = jax.jit(jax.value_and_grad(loss_fn))
-    update = jax.jit(method.update)
     rng = np.random.default_rng(0)
     it = 0
-    for epoch in range(args.max_epoch):
-        perm = rng.permutation(N)
-        for s in range(0, N - args.batch_size + 1, args.batch_size):
-            ix = jnp.asarray(perm[s:s + args.batch_size])
-            loss, g = step(params, ix)
-            params, ostate = update(g, params, ostate, 0.01, it)
-            it += 1
+    if args.sparse_coo:
+        # ragged sparse wide features -> batch-COO SparseMiniBatch
+        from bigdl_tpu.dataset import SparseSample, batch_sparse_samples
+        samples = [SparseSample([wide_ids[i]], [1.0], wide_dim,
+                                dense=[deep_ids[i]], label=labels[i])
+                   for i in range(N)]
+
+        @jax.jit
+        def coo_step(p, os_, coo, dids, yb, it):
+            def lf(p):
+                out, _ = model.apply(p, state, (coo, dids, None))
+                pred = out[:, 0]
+                eps = 1e-7
+                return -jnp.mean(yb * jnp.log(pred + eps)
+                                 + (1 - yb) * jnp.log(1 - pred + eps))
+            loss, g = jax.value_and_grad(lf)(p)
+            p, os_ = method.update(g, p, os_, 0.01, it)
+            return p, os_, loss
+
+        for epoch in range(args.max_epoch):
+            perm = rng.permutation(N)
+            for s in range(0, N - args.batch_size + 1, args.batch_size):
+                mb = batch_sparse_samples(
+                    [samples[i] for i in perm[s:s + args.batch_size]],
+                    nnz_buckets=[args.batch_size])
+                coo, dids = mb.input
+                params, ostate, loss = coo_step(
+                    params, ostate, coo, jnp.asarray(dids),
+                    jnp.asarray(mb.target), it)
+                it += 1
+    else:
+        step = jax.jit(jax.value_and_grad(loss_fn))
+        update = jax.jit(method.update)
+        for epoch in range(args.max_epoch):
+            perm = rng.permutation(N)
+            for s in range(0, N - args.batch_size + 1, args.batch_size):
+                ix = jnp.asarray(perm[s:s + args.batch_size])
+                loss, g = step(params, ix)
+                params, ostate = update(g, params, ostate, 0.01, it)
+                it += 1
     # training AUC-ish: accuracy at 0.5
     all_ix = jnp.arange(N)
     wide_in = (jnp.asarray(wide_bags), jnp.asarray(wide_weights))

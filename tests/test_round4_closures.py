@@ -1,0 +1,91 @@
+"""Round-4 small closures (VERDICT r3 item 9): the last missing
+forward TF ops, the debug_nans opt-in, and their wiring."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.ops.registry import get_op
+
+
+class TestRound4Ops:
+    def test_approximate_equal(self):
+        out = np.asarray(get_op("ApproximateEqual")(
+            {"tolerance": 0.01}, jnp.asarray([1.0, 2.0]),
+            jnp.asarray([1.005, 2.5])))
+        assert out.tolist() == [True, False]
+
+    def test_dilation2d_valid_matches_naive(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (1, 6, 7, 2)).astype(np.float32)
+        f = rng.normal(0, 1, (3, 2, 2)).astype(np.float32)
+        got = np.asarray(get_op("Dilation2D")(
+            {"strides": [1, 1, 1, 1], "rates": [1, 1, 1, 1],
+             "padding": b"VALID"}, jnp.asarray(x), jnp.asarray(f)))
+        OH, OW = 4, 6
+        want = np.zeros((1, OH, OW, 2), np.float32)
+        for y in range(OH):
+            for xx in range(OW):
+                for c in range(2):
+                    want[0, y, xx, c] = max(
+                        x[0, y + dy, xx + dx, c] + f[dy, dx, c]
+                        for dy in range(3) for dx in range(2))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_dilation2d_same_stride2_shape(self):
+        x = jnp.zeros((2, 9, 10, 3))
+        f = jnp.zeros((3, 3, 3))
+        got = get_op("Dilation2D")(
+            {"strides": [1, 2, 2, 1], "rates": [1, 1, 1, 1],
+             "padding": b"SAME"}, x, f)
+        assert got.shape == (2, 5, 5, 3)
+
+    def test_dilation2d_rates(self):
+        # rate 2: effective kernel 3 with holes — max over offsets 0, 2
+        x = jnp.asarray(np.arange(5, dtype=np.float32)
+                        ).reshape(1, 5, 1, 1)
+        f = jnp.zeros((2, 1, 1))
+        got = np.asarray(get_op("Dilation2D")(
+            {"strides": [1, 1, 1, 1], "rates": [1, 2, 1, 1],
+             "padding": b"VALID"}, x, f))
+        np.testing.assert_allclose(got.reshape(-1), [2, 3, 4])
+
+    def test_random_shuffle_deterministic_permutation(self):
+        v = jnp.arange(16)
+        a = np.asarray(get_op("RandomShuffle")(
+            {"seed": 3, "_node_name": "rs"}, v))
+        b = np.asarray(get_op("RandomShuffle")(
+            {"seed": 3, "_node_name": "rs"}, v))
+        assert sorted(a.tolist()) == list(range(16))
+        assert (a == b).all() and a.tolist() != list(range(16))
+
+    def test_substr(self):
+        out = get_op("Substr")(
+            {}, np.asarray([b"hello", b"world"], object), 1, 3)
+        assert out.tolist() == [b"ell", b"orl"]
+
+    def test_assert_noop(self):
+        get_op("Assert")({}, np.asarray(True), np.asarray([1]))
+        with pytest.raises(AssertionError):
+            get_op("Assert")({}, np.asarray(False), np.asarray([42]))
+        assert get_op("NoOp")({}) == ()
+
+
+class TestDebugNans:
+    def test_opt_in_fires_on_nan(self):
+        from bigdl_tpu.utils.config import (apply_debug_config, configure,
+                                            reset_config)
+        try:
+            configure(debug_nans=True)
+            apply_debug_config()
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: (x * 0.0) / (x * 0.0))(jnp.asarray(1.0))
+        finally:
+            configure(debug_nans=False)
+            apply_debug_config()
+            reset_config()
+
+    def test_env_var_coerces(self, monkeypatch):
+        from bigdl_tpu.utils.config import Config
+        monkeypatch.setenv("BIGDL_TPU_DEBUG_NANS", "1")
+        assert Config.from_env().debug_nans is True

@@ -11,42 +11,7 @@ from bigdl_tpu.utils import protowire as pw
 
 from tfgraph_util import (node, attr_tensor, scalar_const, shape_const,
                           string_const, int_scalar_const, attr_int,
-                          attr_type, enter)
-
-
-def build_queue_graph(record_path, batch=8):
-    """GraphDef with its WHOLE input pipeline in-graph:
-    string_input_producer -> TFRecordReader -> DecodeRaw -> example
-    queue -> QueueDequeueManyV2 -> linear regression -> in-graph MSE
-    loss."""
-    g = b""
-    g += node("filenames", "Const", value=string_const([record_path]))
-    g += node("fq", "FIFOQueueV2")
-    g += node("fq_enq", "QueueEnqueueManyV2", ["fq", "filenames"])
-    g += node("reader", "TFRecordReaderV2")
-    g += node("read", "ReaderReadV2", ["reader", "fq"])
-    g += node("decoded", "DecodeRaw", ["read:1"], out_type=attr_type(1))
-    g += node("rec", "Reshape", ["decoded", "rec_shape"])
-    g += node("rec_shape", "Const", value=shape_const([5]))
-    g += node("eq", "FIFOQueueV2")
-    g += node("eq_enq", "QueueEnqueueV2", ["eq", "rec"])
-    g += node("batch_n", "Const", value=int_scalar_const(batch))
-    g += node("dq", "QueueDequeueManyV2", ["eq", "batch_n"])
-    g += node("xb", "Const", value=shape_const([0, 0]))
-    g += node("xs", "Const", value=shape_const([-1, 4]))
-    g += node("x", "Slice", ["dq", "xb", "xs"])
-    g += node("yb", "Const", value=shape_const([0, 4]))
-    g += node("ys", "Const", value=shape_const([-1, 1]))
-    g += node("y", "Slice", ["dq", "yb", "ys"])
-    g += node("w_init", "Const", value=attr_tensor(np.zeros((4, 1))))
-    g += node("W", "VariableV2")
-    g += node("W_assign", "Assign", ["W", "w_init"])
-    g += node("pred", "MatMul", ["x", "W"])
-    g += node("diff", "Sub", ["pred", "y"])
-    g += node("sq", "Square", ["diff"])
-    g += node("red", "Const", value=shape_const([0, 1]))
-    g += node("loss", "Mean", ["sq", "red"])
-    return g
+                          attr_type, enter, build_queue_graph)
 
 
 def build_dynrnn_graph(T, B, I, H, rng):
