@@ -408,10 +408,19 @@ class SpatialBatchNormalization(Module):
             # a single sweep over the (large) activation — jnp.var's
             # two-pass form reads it twice.  Accumulate in f32: bf16
             # squares lose too many bits for the cancellation.
-            xf = input.astype(jnp.float32)
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
-            var = jnp.maximum(var, 0.0)
+            # jax.checkpoint: without it XLA saves the f32 UPCAST of the
+            # bf16 activation as a backward residual (an 822 MB top-level
+            # f32 copy for ResNet-50's stem at batch 256, seen in the
+            # r4 HLO audit); rematerializing the cast trades one cheap
+            # convert for ~2 GB/step of HBM traffic.
+            def _stats(xin):
+                xf = xin.astype(jnp.float32)
+                mean = jnp.mean(xf, axis=axes)
+                var = jnp.mean(jnp.square(xf), axis=axes) \
+                    - jnp.square(mean)
+                return mean, jnp.maximum(var, 0.0)
+
+            mean, var = jax.checkpoint(_stats)(input)
             n = input.size / self.n_output
             unbiased = var * n / max(n - 1, 1)
             m = self.momentum
