@@ -24,5 +24,5 @@ from bigdl_tpu.dataset.prefetch import MTSampleToMiniBatch
 from bigdl_tpu.dataset.datamining import (
     BucketizedCol, CategoricalColHashBucket, CategoricalColVocaList,
     ColToSchema, ColToTensor, ColsToNumeric, CrossCol, IndicatorCol,
-    RowTransformer, RowTransformSchema,
+    Kv2Tensor, RowTransformer, RowTransformSchema,
 )

@@ -173,7 +173,7 @@ class BucketizedCol:
                                side="right").astype(np.int32)
 
 
-def _to_coo(rows, cols, n, n_ids):
+def _to_coo(rows, cols, n, n_ids, vals=None):
     """Assemble a COOBatch from accumulated (row, col) id pairs; a
     NON-empty batch with no ids keeps one zero-valued placeholder entry
     so the stream stays XLA-friendly (an EMPTY batch keeps empty
@@ -182,7 +182,7 @@ def _to_coo(rows, cols, n, n_ids):
     from bigdl_tpu.nn.sparse import COOBatch
     if not rows and n > 0:
         rows, cols, vals = [0], [0], [0.0]
-    else:
+    elif vals is None:
         vals = [1.0] * len(rows)
     return COOBatch(jnp.asarray(np.asarray(rows, np.int32)),
                     jnp.asarray(np.asarray(cols, np.int32)),
@@ -292,6 +292,54 @@ class CrossCol:
                 rows.append(r)
                 cols.append(_hash_bucket(c, self.n_ids))
         return _to_coo(rows, cols, n, self.n_ids)
+
+
+class Kv2Tensor:
+    """Parse "k:v" string columns into a dense matrix or COOBatch
+    (reference ``nn/ops/Kv2Tensor.scala:46`` — ``transType=0`` dense,
+    ``1`` sparse; key = integer column index into ``fea_len``).
+
+    The reference runs this as a graph Operation fed a string tensor;
+    strings cannot enter a jitted TPU program, so here it is a
+    host-side feature column like its siblings above — same pipeline
+    stage, same output contract (dense ``(N, fea_len)`` float32 or a
+    ``COOBatch`` with that dense shape)."""
+
+    def __init__(self, kv_delimiter: str = ",", item_delimiter: str = ":",
+                 trans_type: int = 0):
+        if trans_type not in (0, 1):
+            raise ValueError("trans_type must be 0 (dense) or 1 (sparse)")
+        self.kv_delimiter = kv_delimiter
+        self.item_delimiter = item_delimiter
+        self.trans_type = trans_type
+
+    def __call__(self, column: Sequence, fea_len: int):
+        rows, cols, vals = [], [], []
+        for r, s in enumerate(column):
+            for kv in str(s).split(self.kv_delimiter):
+                if kv == "":
+                    continue
+                try:
+                    k_str, v_str = kv.split(self.item_delimiter, 1)
+                    k, v = int(k_str), float(v_str)
+                except ValueError as e:
+                    raise ValueError(
+                        f"Kv2Tensor: malformed entry {kv!r} in row {r} "
+                        f"({s!r}) — expected "
+                        f"'<int>{self.item_delimiter}<float>'") from e
+                if not 0 <= k < fea_len:
+                    raise ValueError(
+                        f"key {k} out of range for fea_len={fea_len}")
+                rows.append(r)
+                cols.append(k)
+                vals.append(v)
+        if self.trans_type == 0:
+            out = np.zeros((len(column), fea_len), np.float32)
+            # duplicate keys accumulate, matching the reference's
+            # SparseTensor→dense semantics
+            np.add.at(out, (rows, cols), vals)
+            return out
+        return _to_coo(rows, cols, len(column), fea_len, vals)
 
 
 class IndicatorCol:

@@ -298,6 +298,12 @@ class ConvLSTMPeephole3D(Cell):
         return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
 
     def step(self, params, x_t, hidden):
+        if self.with_peephole and "peep" not in params:
+            raise KeyError(
+                "ConvLSTMPeephole3D now defaults to with_peephole=True "
+                "(the reference default); these params have no 'peep' "
+                "entry — construct with with_peephole=False to restore "
+                "a peephole-free checkpoint")
         h, c = hidden
         z = lax.conv_general_dilated(
             jnp.concatenate([x_t, h], axis=1), params["weight"],

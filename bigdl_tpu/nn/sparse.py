@@ -147,10 +147,14 @@ class LookupTableSparse(Module):
             return summed
         w = coo.values
         if self.combiner == "mean":
-            denom = coo_row_reduce(coo, jnp.abs(w))
+            # reference LookupTableSparse.scala:123-133 accumulates RAW
+            # weights (batchScale = 1/sum(w)), so negative per-id weights
+            # must flow through un-absed; guard only exact zeros
+            denom = coo_row_reduce(coo, w)
+            denom = jnp.where(jnp.abs(denom) < 1e-12, 1e-12, denom)
         else:  # sqrtn
-            denom = jnp.sqrt(coo_row_reduce(coo, w * w))
-        return summed / jnp.maximum(denom[:, None], 1e-12)
+            denom = jnp.maximum(jnp.sqrt(coo_row_reduce(coo, w * w)), 1e-12)
+        return summed / denom[:, None]
 
     def apply(self, params, state, input, *, training=False, rng=None):
         if isinstance(input, COOBatch):
@@ -169,10 +173,13 @@ class LookupTableSparse(Module):
         summed = jnp.einsum("nbo,nb->no", emb, w)
         if self.combiner == "sum":
             return summed, state
-        denom = jnp.sum(jnp.abs(w), axis=1, keepdims=True)
         if self.combiner == "sqrtn":
-            denom = jnp.sqrt(jnp.sum(w * w, axis=1, keepdims=True))
-        return summed / jnp.maximum(denom, 1e-12), state
+            denom = jnp.maximum(
+                jnp.sqrt(jnp.sum(w * w, axis=1, keepdims=True)), 1e-12)
+        else:  # mean: raw weight sum (reference LookupTableSparse.scala:123)
+            denom = jnp.sum(w, axis=1, keepdims=True)
+            denom = jnp.where(jnp.abs(denom) < 1e-12, 1e-12, denom)
+        return summed / denom, state
 
 
 class SparseLinear(Module):

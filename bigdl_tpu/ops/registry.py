@@ -312,6 +312,11 @@ def _bias_add(attrs, x, b):
     return x + b
 
 
+# the TF-0.x name: no data_format attr, always channel-last broadcast
+# (reference loaders/BiasAddV1.scala:27 → same BiasAddOp)
+OPS["BiasAddV1"] = lambda attrs, x, b: x + b
+
+
 @register_op("Conv2D")
 def _conv2d(attrs, x, w):
     # w: HWIO (TF kernel layout)
@@ -448,6 +453,9 @@ for _name, _fn in _UNOPS_R3.items():
 OPS["TruncateDiv"] = lambda attrs, a, b: jnp.trunc(a / b).astype(
     jnp.result_type(a, b))
 OPS["TruncateMod"] = lambda attrs, a, b: jnp.fmod(a, b)
+# TF FloorMod is floored modulo — result takes the divisor's sign,
+# exactly jnp.mod (reference loaders/FloorMod.scala:28 → FloorModOps)
+OPS["FloorMod"] = lambda attrs, a, b: jnp.mod(a, b)
 
 
 @register_op("Range")

@@ -112,9 +112,16 @@ class SGD(OptimMethod):
         # accumulate would round-to-nearest first and systematically
         # drop sub-ulp updates (the bias SR exists to remove)
         acc = jnp.float32 if self.state_dtype is not None else None
-        vel = tmap(lambda v, g: mu * v.astype(acc or g.dtype)
-                   + (1 - damp) * g.astype(acc or g.dtype),
-                   opt_state["velocity"], grads)
+
+        def _vel(v, g):
+            # default path: accumulate at the WIDER of (velocity, grad)
+            # dtypes — a bf16 gradient must not silently demote the f32
+            # velocity (dtype flip ⇒ retrace + precision loss)
+            dt = acc if acc is not None else jnp.promote_types(v.dtype,
+                                                               g.dtype)
+            return mu * v.astype(dt) + (1 - damp) * g.astype(dt)
+
+        vel = tmap(_vel, opt_state["velocity"], grads)
         if self.nesterov:
             upd = tmap(lambda g, v: g + mu * v, grads, vel)
         else:

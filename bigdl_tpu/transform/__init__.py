@@ -6,7 +6,8 @@ Reference: ``DL/transform/vision/`` (30 files, 4,008 LoC).
 from bigdl_tpu.transform.vision import (
     ImageFeature, ImageFrame, LocalImageFrame, FeatureTransformer,
     Brightness, Contrast, Saturation, Hue, ChannelNormalize, PixelNormalizer,
-    Expand, Filler, HFlip, Resize, AspectScale, RandomAspectScale,
+    ChannelScaledNormalizer, Expand, Filler, HFlip, Resize, AspectScale,
+    RandomAspectScale, RandomResize,
     CenterCrop, RandomCrop, FixedCrop, RandomAlterAspect, ChannelOrder,
     ColorJitter, Lighting, RandomTransformer, MatToFloats, ImageFrameToSample,
 )

@@ -232,6 +232,21 @@ class ChannelNormalize(FeatureTransformer):
         return f
 
 
+class ChannelScaledNormalizer(FeatureTransformer):
+    """(x - mean_c) * scale per channel (reference
+    ``augmentation/ChannelScaledNormalizer.scala:42`` — integer
+    per-channel means with one shared scale factor)."""
+
+    def __init__(self, mean_r: int, mean_g: int, mean_b: int,
+                 scale: float):
+        self.mean = np.asarray([mean_r, mean_g, mean_b], np.float32)
+        self.scale = float(scale)
+
+    def transform(self, f):
+        f.image = ((f.image - self.mean) * self.scale).astype(np.float32)
+        return f
+
+
 class PixelNormalizer(FeatureTransformer):
     """Subtract a per-pixel mean image (reference ``PixelNormalizer.scala``)."""
 
@@ -279,6 +294,31 @@ class AspectScale(FeatureTransformer):
         f.image = _resize_bilinear(f.image, int(round(h * scale)),
                                    int(round(w * scale)))
         f["scale"] = scale
+        return f
+
+
+class RandomResize(FeatureTransformer):
+    """Resize the SHORT edge to a uniform random size in
+    ``[min_size, max_size]``, scaling the long edge to preserve aspect
+    ratio (reference ``augmentation/RandomResize.scala:32``)."""
+
+    def __init__(self, min_size: int, max_size: int, seed: int = 0):
+        if max_size < min_size:
+            raise ValueError("max_size must be >= min_size")
+        self.min_size, self.max_size = min_size, max_size
+        self._rng = ThreadRng(seed, salt=type(self).__name__)
+
+    def transform(self, f):
+        h, w = f.image.shape[:2]
+        short = self.min_size + int(self._rng.uniform(
+            1e-2, self.max_size - self.min_size + 1))
+        if h < w:
+            w = int(w / h * short)
+            h = short
+        else:
+            h = int(h / w * short)
+            w = short
+        f.image = _resize_bilinear(f.image, h, w)
         return f
 
 

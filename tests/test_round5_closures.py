@@ -1,0 +1,113 @@
+"""Round-5 closures: the last reference trivia (VERDICT r4 missing
+#2-4 — FloorMod/BiasAddV1 TF ops, Kv2Tensor feature column,
+ChannelScaledNormalizer/RandomResize augmentations) and the r4 advisor
+fixes (LookupTableSparse raw-weight mean, ConvLSTMPeephole3D checkpoint
+guard, SGD velocity dtype promotion)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.ops.registry import get_op
+
+
+class TestLastTFOps:
+    def test_floor_mod_sign_follows_divisor(self):
+        # floored modulo (TF FloorMod): result carries the DIVISOR's
+        # sign — the property that distinguishes it from TruncateMod
+        a = jnp.asarray([7.0, -7.0, 7.0, -7.0])
+        b = jnp.asarray([3.0, 3.0, -3.0, -3.0])
+        out = np.asarray(get_op("FloorMod")({}, a, b))
+        np.testing.assert_allclose(out, [1.0, 2.0, -2.0, -1.0])
+        got = np.asarray(get_op("FloorMod")(
+            {}, jnp.asarray([7, -7], jnp.int32), jnp.asarray(3, jnp.int32)))
+        np.testing.assert_array_equal(got, [1, 2])
+
+    def test_bias_add_v1(self):
+        x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        b = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        out = np.asarray(get_op("BiasAddV1")({}, x, b))
+        np.testing.assert_allclose(out, np.asarray(x) + np.asarray(b))
+
+
+class TestKv2Tensor:
+    def test_dense(self):
+        from bigdl_tpu.dataset import Kv2Tensor
+        op = Kv2Tensor()
+        out = op(["0:1.5,2:2.0", "1:3.0", ""], fea_len=4)
+        want = np.zeros((3, 4), np.float32)
+        want[0, 0], want[0, 2], want[1, 1] = 1.5, 2.0, 3.0
+        np.testing.assert_allclose(out, want)
+
+    def test_sparse_matches_dense(self):
+        from bigdl_tpu.dataset import Kv2Tensor
+        col = ["0:1.0,3:4.0", "2:-2.5"]
+        dense = Kv2Tensor(trans_type=0)(col, fea_len=5)
+        coo = Kv2Tensor(trans_type=1)(col, fea_len=5)
+        assert coo.dense_shape == (2, 5)
+        np.testing.assert_allclose(np.asarray(coo.to_dense()), dense)
+
+    def test_custom_delimiters_and_range_check(self):
+        from bigdl_tpu.dataset import Kv2Tensor
+        out = Kv2Tensor(kv_delimiter=";", item_delimiter="=")(
+            ["1=2.0;0=1.0"], fea_len=2)
+        np.testing.assert_allclose(out, [[1.0, 2.0]])
+        with pytest.raises(ValueError):
+            Kv2Tensor()(["9:1.0"], fea_len=4)
+
+
+class TestNewAugmentations:
+    def _feature(self, h, w):
+        from bigdl_tpu.transform import ImageFeature
+        rng = np.random.default_rng(0)
+        f = ImageFeature()
+        f.image = rng.uniform(0, 255, (h, w, 3)).astype(np.float32)
+        return f
+
+    def test_channel_scaled_normalizer(self):
+        from bigdl_tpu.transform import ChannelScaledNormalizer
+        f = self._feature(4, 5)
+        img = f.image.copy()
+        out = ChannelScaledNormalizer(10, 20, 30, 0.5).transform(f)
+        want = (img - np.asarray([10, 20, 30], np.float32)) * 0.5
+        np.testing.assert_allclose(out.image, want, rtol=1e-6)
+
+    def test_random_resize_short_edge_in_range(self):
+        from bigdl_tpu.transform import RandomResize
+        t = RandomResize(8, 16, seed=3)
+        for _ in range(5):
+            f = self._feature(20, 30)
+            out = t.transform(f)
+            h, w = out.image.shape[:2]
+            assert 8 <= min(h, w) <= 16
+            # aspect ratio preserved (int truncation tolerance)
+            assert abs(w / h - 30 / 20) < 0.15
+
+    def test_random_resize_portrait(self):
+        from bigdl_tpu.transform import RandomResize
+        f = self._feature(40, 10)
+        out = RandomResize(12, 12, seed=0).transform(f)
+        assert out.image.shape[:2] == (48, 12)
+
+
+class TestAdvisorFixes:
+    def test_convlstm3d_checkpoint_guard(self):
+        from bigdl_tpu.nn.recurrent import ConvLSTMPeephole3D
+        cell = ConvLSTMPeephole3D(2, 3, spatial=(2, 4, 4))
+        old = ConvLSTMPeephole3D(2, 3, spatial=(2, 4, 4),
+                                 with_peephole=False)
+        params, _ = old.init(jax.random.PRNGKey(0))
+        x = jnp.zeros((1, 2, 2, 4, 4))
+        hidden = cell.initial_hidden(1)
+        with pytest.raises(KeyError, match="with_peephole=False"):
+            cell.step(params, x, hidden)
+
+    def test_sgd_velocity_stays_f32_under_bf16_grads(self):
+        from bigdl_tpu import optim
+        m = optim.SGD(learning_rate=0.1, momentum=0.9)
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        state = m.init_state(params)
+        assert state["velocity"]["w"].dtype == jnp.float32
+        grads = {"w": jnp.full((4,), 0.5, jnp.bfloat16)}
+        _, state = m.update(grads, params, state, 0.1, 0)
+        assert state["velocity"]["w"].dtype == jnp.float32

@@ -63,6 +63,25 @@ class TestCOOKernels:
         np.testing.assert_allclose(np.asarray(y_bag), np.asarray(y_coo),
                                    atol=1e-5)
 
+    def test_lookup_mean_negative_weights_raw_sum(self):
+        # reference LookupTableSparse.scala:123-133: mean divides by the
+        # RAW weight sum, so negative weights must not be abs()ed.
+        # row0: 2*e0 + (-1)*e2 over denom (2 - 1) = 1
+        ids = np.array([[0, 2]], np.int32)
+        w = np.array([[2.0, -1.0]], np.float32)
+        m = nn.LookupTableSparse(5, 4, "mean")
+        p, s = m.init(jax.random.PRNGKey(0))
+        want = 2.0 * p["weight"][0] - 1.0 * p["weight"][2]  # denom == 1
+        y_bag, _ = m.apply(p, s, (jnp.asarray(ids), jnp.asarray(w)))
+        np.testing.assert_allclose(np.asarray(y_bag[0]), np.asarray(want),
+                                   atol=1e-5)
+        coo = COOBatch(jnp.asarray([0, 0], jnp.int32),
+                       jnp.asarray([0, 2], jnp.int32),
+                       jnp.asarray([2.0, -1.0], jnp.float32), (1, 5))
+        y_coo, _ = m.apply(p, s, coo)
+        np.testing.assert_allclose(np.asarray(y_coo), np.asarray(y_bag),
+                                   atol=1e-5)
+
     def test_join_table_coo(self):
         c1 = COOBatch(jnp.asarray([0, 1], jnp.int32),
                       jnp.asarray([1, 0], jnp.int32),
