@@ -41,6 +41,11 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
 
+    import logging
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
     from bigdl_tpu import nn, optim
     from bigdl_tpu.dataset import (DataSet, MTSampleToMiniBatch,
                                    SampleToMiniBatch, cifar, image)
