@@ -2,40 +2,82 @@
 
 Analog of the reference's throughput harness
 ``DL/models/utils/DistriOptimizerPerf.scala:56-140`` (synthetic-input
-records/sec).  Measures BOTH BASELINE.json models — ResNet-50 and
-Inception-v1 — as full ImageNet training steps (fwd+bwd+SGD-momentum
-update) on the local TPU chip: images/sec/chip.
+records/sec).  Measures a four-model menu on the local TPU chip, all as
+full training steps (fwd+bwd+SGD-momentum update): the two
+BASELINE.json models — ResNet-50 and Inception-v1 (images/sec/chip) —
+plus, since round 5, VGG-16 (images/sec; the conv-heavy regression
+sentinel) and the PTB "medium" LSTM (words/sec; the scan-heavy one).
+ResNet-50 failing aborts the capture (it is the headline metric); a
+failure in any secondary model records a ``<model>_error`` key and the
+rest of the capture survives.
 
 Config: NHWC, bf16 compute / f32 master params, batch 256, donated
 buffers — best of the layout×batch×remat sweep on v5e (see git
 history; batch 512 regresses ~6% past its own bandwidth floor from
 memory pressure, FULL per-block remat costs ~20% because recomputed
-convs re-read activations — the "tails" variant that saves conv
-outputs and recomputes only BN/ReLU is selected per measurement).
+convs re-read activations).
 
-Variance discipline (round-4): the reported value is the MEDIAN over
-``windows`` independent timing windows (fresh compile excluded), with
-the min/max/relative spread attached, so a ±3% wobble can be told from
-a real regression.  Round-3's best-of-4 could not.
+Integrity discipline (round-5, VERDICT r4 item 1):
+- ``toolchain`` stamps jax/jaxlib versions + platform/device into every
+  emitted JSON: r3→r4 showed cross-round numbers are toolchain-
+  confounded (jax 0.8→0.9 moved ResNet's compiled step from 78.7 to
+  ~85 GB/step with IDENTICAL source — a 5% throughput drop that is a
+  compiler property, not a code property).
+- AOT compile / cost-analysis failure is NEVER silent: the JSON either
+  carries ``bottleneck`` + ``mfu`` or a ``cost_analysis_error`` string,
+  and ``timing_path`` says whether the timing loop ran the AOT
+  executable or fell back to jit dispatch.
+- every measured window ends with a host sync that ASSERTS the loss is
+  finite — a NaN-producing step can't post a throughput number.
+- ``value`` is the MEDIAN over ``windows`` independent timing windows
+  (the r4 definition); ``best_window`` is also reported as the bridge
+  to r2/r3, whose ``value`` was best-of-4.
 
 ``bottleneck`` is TRACE-BACKED, not asserted: XLA's compiled-executable
 cost analysis (flops + bytes accessed) gives the MXU-time and HBM-time
-floors; the measured step time is compared against both — for BOTH
-models since round 4.
+floors; the measured step time is compared against both.  ``mfu`` uses
+the XLA-counted flops over the 197 TFLOP/s v5e bf16 peak (XLA counts
+2 flops/MAC — the same convention as the spec number).
 
-``mfu`` uses the XLA-counted flops of the compiled step (not a paper
-constant) over the 197 TFLOP/s v5e bf16 peak.  XLA counts 2 flops per
-MAC — the same convention as the 197 TFLOP/s spec.
+``chip_gate`` (round-5, VERDICT r4 item 2): the pytest suite pins CPU
+by design, so the bench — the one thing that touches the real chip —
+now also proves the chip computes CORRECT numbers: it trains LeNet and
+ResNet-CIFAR on-device via the example entry scripts with the exact
+flags and bars of the CPU suite gates
+(``tests/test_accuracy_gates.py::test_lenet_synthetic_accuracy_gate``:
+val top-1 ≥ 0.99; ``tests/test_zoo_recipes.py::test_resnet_cifar_recipe``:
+final loss < 2.0) and additionally asserts the logged loss DECREASED
+from the first iteration.  Mirrors the reference testing its real
+engine end-to-end (``TEST/optim/DistriOptimizerSpec.scala:139``).
 
-``scaling_efficiency`` (round-4, always emitted): fixed-global-batch
-SPMD partitioning overhead on a 1-vs-8 virtual CPU mesh (the only
-standing proxy this single-chip environment can produce for the
-BASELINE ">60% efficiency 1→32 chips" claim; reference
-``docs/docs/whitepaper.md:160-164``).  Gate: ≥0.6 at 8 devices.
+``collective_overhead_fraction`` (round-5, VERDICT r4 item 3): the r4
+1-vs-8 "scaling efficiency" proxy measured cache effects (1.28 on one
+core — physically meaningless as a collective gate).  Replaced by a
+DIRECT ablation on the 8-device CPU mesh: the same shard_map DP
+training step timed with the gradient all-reduce present vs ablated —
+identical per-device compute, so the delta IS the collective cost.
+Calibration notes (measured on this box, 2026-07-30): ResNet-20's
+0.27M params make the psum invisible inside ±5% step noise, so the
+workload is a deliberately param-heavy MLP (3×2048² ≈ 12.6M params,
+50 MB/psum) where the host-emulated all-reduce is unambiguous.  Two
+independent calibration runs: ablated 598/616 ms/step, with 879/866
+(fraction 0.32/0.29), 3 injected extra all-reduces 1140/1123
+(fraction 0.48/0.45).  Gate: fraction ≤ 0.38 — above the measured
+band, below the injected band, ~2 extra all-reduces trip it — and a
+SELF-TEST
+run with the 3 extra all-reduces must itself VIOLATE the gate, proving
+on every bench run that the gate can fail (VERDICT r4's "done"
+criterion).  The absolute fraction is a property of the host-mesh
+emulation (ICI is ~100× faster than host-memory loopback), so the
+gate is a round-over-round regression tripwire, not an efficiency
+claim; the real >60%-at-32-chips claim (whitepaper.md:160-164) needs
+pod hardware.  The old 1-vs-8 number is kept informational only and
+values > 1.05 are flagged ``measurement_error`` (super-linear
+"scaling" on one physical core means cache effects dominate).
 
 Round-4 experiment log (all medians over ≥5 windows, v5e, batch 256;
-baseline ResNet-50 2499.7 img/s / 78.7 GB/step, Inception-v1 4645 /
-37.3 GB/step):
+r3 baseline ResNet-50 2499.7 img/s / 78.7 GB/step under jax 0.8,
+Inception-v1 4645 / 37.3 GB/step):
 - remat="tails" (save conv outputs, recompute BN/ReLU): 2160 img/s,
   bytes 92.5 GB — XLA's own saved-residual choice already beats the
   forced policy, and checkpoint boundaries block cross-block fusion.
@@ -43,8 +85,8 @@ baseline ResNet-50 2499.7 img/s / 78.7 GB/step, Inception-v1 4645 /
 - batch 384: 2442 img/s, floor-fraction drops 0.94→0.84 (memory
   pressure); batch 512 worse still (r2).
 - bf16 stochastic-rounded momentum: 2443 img/s, bytes 79.5 GB —
-  optimizer state is 0.26% of step traffic; the SR noise costs more
-  than it saves.  Kept as a memory-capacity option (SGD state_dtype).
+  optimizer state is 0.26% of step traffic; kept as a memory-capacity
+  option (SGD state_dtype).
 - maxpool backward (select-and-scatter) replacements: ablations show
   S&S wastes ~8.6 ms/step on Inception (pool-stubbed model runs at
   96.8% of its floor vs 82.6% real), but every alternative loses more:
@@ -52,16 +94,15 @@ baseline ResNet-50 2499.7 img/s / 78.7 GB/step, Inception-v1 4645 /
   (layout copies: pallas can't accept XLA's batch-minor layouts),
   hand-written custom-vjp 95.9 GB.  See nn/layers.py SpatialMaxPooling
   and ops/pallas_pool.py.
-- Inception MFU ceiling: at its own HBM floor (45.5 ms) MFU caps at
-  0.254, so the 0.28 target is unreachable without removing bytes the
-  model actually moves; measured 0.21 = 83% of that roofline, with the
-  S&S waste above accounting for most of the residual gap.
+Round-5 log lives in BASELINE.md §"jax 0.9 floor shift".
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
+import re
 import statistics
 import sys
 import time
@@ -69,29 +110,61 @@ import time
 import numpy as np
 
 # round-1 recorded TPU v5 lite measurement (bf16, NCHW, batch 64); later
-# rounds report improvement vs this anchor
+# rounds report improvement vs this anchor.  NOTE the anchor was taken
+# under jax 0.8 — the `toolchain` stamp exists precisely because this
+# ratio is toolchain-confounded across rounds.
 BASELINE_IMAGES_PER_SEC = 1945.9  # 2026-07-29 r01
 PEAK_BF16_FLOPS = 197e12          # v5e MXU peak
 HBM_BYTES_PER_SEC = 819e9         # v5e HBM bandwidth
 
+ROOT = os.path.dirname(os.path.abspath(__file__))
 
-def _measure(model, batch: int, windows: int = 6, iters: int = 32):
-    """Compile + run one training step; return (per-window img/s list,
-    cost-analysis dict)."""
+
+def _toolchain():
+    """Version/platform stamp embedded in every emitted JSON."""
+    import jax
+    import jaxlib
+    dev = jax.devices()[0]
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+    }
+
+
+def _measure(model, batch: int, windows: int = 6, iters: int = 32,
+             x=None, y=None, criterion=None, units_per_step=None):
+    """Compile + run one training step.
+
+    Default inputs are the ImageNet-shaped NHWC batch; recurrent/other
+    models pass explicit ``x``/``y``/``criterion``.  ``units_per_step``
+    is the throughput numerator (images for conv nets, words for LMs;
+    defaults to ``batch``).
+
+    Returns ``(per-window units/s list, cost-analysis dict,
+    timing_path)`` where cost-analysis is either ``{"flops", "bytes"}``
+    or ``{"error": <msg>}`` — never silently empty — and
+    ``timing_path`` records whether the timing loop ran the AOT
+    executable or jit dispatch.  Raises if any measured window ends
+    with a non-finite loss.
+    """
     import jax
     import jax.numpy as jnp
     from functools import partial
     from bigdl_tpu import nn, optim
     from bigdl_tpu.utils.precision import mixed_precision_loss_fn
 
-    criterion = nn.ClassNLLCriterion()
+    criterion = criterion or nn.ClassNLLCriterion()
+    units_per_step = units_per_step or batch
     method = optim.SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
     params, mstate = model.init(jax.random.PRNGKey(0))
     ostate = method.init_state(params)
-    x = jnp.asarray(np.random.default_rng(0).normal(
-        0, 1, (batch, 224, 224, 3)).astype(np.float32))
-    y = jnp.asarray(np.random.default_rng(1).integers(
-        0, 1000, (batch,)).astype(np.int32))
+    if x is None:
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            0, 1, (batch, 224, 224, 3)).astype(np.float32))
+        y = jnp.asarray(np.random.default_rng(1).integers(
+            0, 1000, (batch,)).astype(np.int32))
 
     base_loss = mixed_precision_loss_fn(model, criterion, jnp.bfloat16)
     grad_fn = jax.value_and_grad(base_loss, has_aux=True)
@@ -104,9 +177,11 @@ def _measure(model, batch: int, windows: int = 6, iters: int = 32):
         return p, ms, os_, loss
 
     # ONE compile: the AOT executable serves both cost_analysis and the
-    # timing loop (a separate jit dispatch would compile a second time)
-    ca = {}
+    # timing loop (a separate jit dispatch would compile a second time).
+    # Failure here is NOT allowed to be silent (VERDICT r4 weak#1: the
+    # r4 BENCH capture lost mfu/bottleneck to an `except: pass`).
     run = step
+    timing_path = "aot"
     try:
         compiled = step.lower(params, mstate, ostate, x, y, 0.1, 0,
                               rng0).compile()
@@ -116,8 +191,9 @@ def _measure(model, batch: int, windows: int = 6, iters: int = 32):
         ca = {"flops": float(c.get("flops", 0.0)),
               "bytes": float(c.get("bytes accessed", 0.0))}
         run = compiled
-    except Exception:
-        pass
+    except Exception as e:  # recorded in the JSON, never dropped
+        ca = {"error": f"{type(e).__name__}: {e}"}
+        timing_path = "jit_dispatch"
 
     # warmup.  NOTE: on the experimental 'axon' TPU platform
     # block_until_ready does not actually wait for completion — a host
@@ -133,9 +209,14 @@ def _measure(model, batch: int, windows: int = 6, iters: int = 32):
             params, mstate, ostate, loss = run(
                 params, mstate, ostate, x, y, np.float32(0.1),
                 np.int32(w * iters + i), rng0)
-        float(loss)  # full pipeline sync
-        samples.append(batch * iters / (time.perf_counter() - t0))
-    return samples, ca
+        lv = float(loss)  # full pipeline sync
+        if not math.isfinite(lv):
+            raise RuntimeError(
+                f"non-finite loss {lv} at end of measured window {w} — "
+                f"refusing to report a throughput number for a broken "
+                f"computation")
+        samples.append(units_per_step * iters / (time.perf_counter() - t0))
+    return samples, ca, timing_path
 
 
 def _stats(samples):
@@ -166,112 +247,283 @@ def _bottleneck(ca, ips, batch):
     }
 
 
+# ------------------------------------------------------------ chip gate
+_ITER_RE = re.compile(r"epoch \d+ iter (\d+) loss (\S+)")
+
+
+def _run_example(script, *args, timeout=2400):
+    """Run an example training script ON THE DEFAULT PLATFORM (the real
+    chip when present — deliberately NO --cpu flag) and parse the
+    final: line plus the first/last per-iteration logged losses."""
+    import subprocess
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # don't leak a CPU-mesh device count
+    try:
+        r = subprocess.run([sys.executable, os.path.join(ROOT, script),
+                            *args], capture_output=True, text=True,
+                           timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": f"{script}: timed out after {timeout}s"}
+    if r.returncode != 0:
+        return {"error": f"{script} rc={r.returncode}: "
+                         f"{r.stderr[-800:]}"}
+    out = {}
+    for line in r.stdout.splitlines():
+        if line.startswith("final:"):
+            for kv in line.split()[1:]:
+                k, _, v = kv.partition("=")
+                try:
+                    out[k] = float(v)
+                except ValueError:
+                    pass
+    # the CHILD's device (LocalOptimizer logs "device=<dev>") — the
+    # parent's platform says nothing about where the child trained
+    m = re.search(r"device=([^\n]+)", r.stderr)
+    if m:
+        out["device"] = m.group(1).strip()
+    iters = _ITER_RE.findall(r.stderr)
+    try:
+        if iters:
+            out["first_iter_loss"] = float(iters[0][1])
+            out["last_iter_loss"] = float(iters[-1][1])
+    except ValueError:
+        pass  # unparseable loss token: fall through to the bar checks
+    if not out:
+        out = {"error": f"{script}: no final/iter lines parsed"}
+    return out
+
+
+def _chip_gate():
+    """Train on the real chip with the CPU suite's exact gate recipes;
+    PASS needs the same bars, a first→last loss decrease, AND — when
+    this process sees a TPU — child-logged evidence that the children
+    trained on it too (a dropped tunnel must not masquerade as a
+    chip-validated pass)."""
+    gate = {"platform": _toolchain()["platform"]}
+    lenet = _run_example("examples/lenet/train.py", "-e", "3",
+                         "--synthetic-n", "4096", "-b", "128")
+    gate["lenet"] = lenet
+    lenet_ok = ("error" not in lenet
+                and lenet.get("val_top1", 0.0) >= 0.99
+                and lenet.get("last_iter_loss", float("inf"))
+                < lenet.get("first_iter_loss", 0.0))
+    resnet = _run_example("examples/resnet/train_cifar10.py", "-e", "2",
+                          "--synthetic-n", "512", "-b", "64")
+    gate["resnet_cifar"] = resnet
+    resnet_ok = ("error" not in resnet
+                 and resnet.get("loss", float("inf")) < 2.0
+                 and resnet.get("last_iter_loss", float("inf"))
+                 < resnet.get("first_iter_loss", 0.0))
+    gate["lenet_top1"] = lenet.get("val_top1")
+    on_chip = ("TPU" in str(lenet.get("device", "")).upper()
+               and "TPU" in str(resnet.get("device", "")).upper())
+    gate["on_chip"] = on_chip
+    chip_consistent = on_chip or gate["platform"] != "tpu"
+    gate["pass"] = bool(lenet_ok and resnet_ok and chip_consistent)
+    return gate
+
+
+# ----------------------------------------------- collective overhead
+def _cpu_mesh_env(n=8, **extra):
+    """Env for a CPU-mesh child: strip any inherited device-count flag,
+    then force an n-device host platform."""
+    env = dict(os.environ)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.update(extra)
+    return env
+
+
+def _collective_child_run(mode):
+    return subprocess_run([sys.executable, __file__, "--collective-child"],
+                          env=_cpu_mesh_env(_BENCH_COLL_MODE=mode))
+
+
+COLLECTIVE_GATE = 0.38  # calibration in module doc
+
+
+def _collective_overhead():
+    """Direct collective-cost ablation (module doc).  Returns the JSON
+    fragment; a crashed child reads as a FAILed gate upstream."""
+    times = {}
+    for mode in ("ablated", "with", "inject"):
+        t = _collective_child_run(mode)
+        if t is None:
+            return None
+        times[mode] = t
+    frac = (times["with"] - times["ablated"]) / times["with"]
+    frac_inj = (times["inject"] - times["ablated"]) / times["inject"]
+    # self-test: the run with 3 injected extra all-reduces must itself
+    # VIOLATE the gate — otherwise the gate has no discriminating power
+    # and must read red regardless of the real fraction
+    selftest = frac_inj > COLLECTIVE_GATE
+    return {
+        "collective_overhead_fraction": round(frac, 4),
+        "collective_step_ms": {k: round(v, 2) for k, v in times.items()},
+        "collective_gate_0p38": "pass"
+                                if (selftest and frac <= COLLECTIVE_GATE)
+                                else "FAIL",
+        "collective_selftest_injected_fraction": round(frac_inj, 4),
+        "collective_selftest": "pass" if selftest else "FAIL",
+    }
+
+
 def _scaling_efficiency():
-    """1-vs-8 virtual-CPU-mesh partitioning overhead (see module doc).
-    Subprocess-isolated so the TPU backend in this process is
-    untouched."""
+    """INFORMATIONAL 1-vs-8 virtual-CPU-mesh number (r4's proxy).  On
+    one physical core this mostly measures cache effects — r4 recorded
+    a physically-impossible 1.28 — so it no longer gates anything;
+    values > 1.05 are flagged as measurement error."""
     results = {}
     for n in (1, 8):
-        env = dict(os.environ)
-        flags = [f for f in env.get("XLA_FLAGS", "").split()
-                 if not f.startswith(
-                     "--xla_force_host_platform_device_count")]
-        flags.append("--xla_force_host_platform_device_count=8")
-        env["XLA_FLAGS"] = " ".join(flags)
-        env["_BENCH_SCALING_N"] = str(n)
         out = subprocess_run([sys.executable, __file__, "--scaling-child"],
-                             env=env)
+                             env=_cpu_mesh_env(_BENCH_SCALING_N=str(n)))
         if out is None:
             return None
         results[n] = out
+    value = round(results[8] / results[1], 3)
     return {
-        "value": round(results[8] / results[1], 3),
+        "value": value,
+        "measurement_error": value > 1.05,
         "images_per_sec": {str(n): round(v, 1)
                            for n, v in results.items()},
     }
 
 
-def subprocess_run(cmd, env):
+def subprocess_run(cmd, env, timeout=1200):
     import subprocess
-    out = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    try:
+        out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"child timed out after {timeout}s: {cmd}", file=sys.stderr)
+        return None
     if out.returncode != 0:
         print(out.stderr[-2000:], file=sys.stderr)
         return None
-    return float(out.stdout.strip().splitlines()[-1])
+    try:
+        return float(out.stdout.strip().splitlines()[-1])
+    except (IndexError, ValueError):
+        # a zero-exit child with unparseable stdout degrades to the
+        # recorded-FAIL path, same as a crash (ADVICE r4 #4)
+        print(f"unparseable child stdout: {out.stdout[-500:]!r}",
+              file=sys.stderr)
+        return None
 
 
 def main(argv):
     from bigdl_tpu.models.resnet import resnet50
     from bigdl_tpu.models.inception import inception_v1
 
+    smoke = "--smoke" in argv
+    windows, iters = (1, 4) if smoke else (6, 32)
     batch = 256
     remat = "tails" if "--remat-tails" in argv else (
         True if "--remat-full" in argv else False)
-    r_samples, r_ca = _measure(resnet50(format="NHWC", remat=remat), batch)
+    r_samples, r_ca, r_path = _measure(resnet50(format="NHWC", remat=remat),
+                                       batch, windows, iters)
     r_ips, r_spread = _stats(r_samples)
-    if "--resnet-only" in argv:
-        out = {"metric": "resnet50_train_images_per_sec_per_chip",
-               "value": round(r_ips, 1), "spread": r_spread,
-               "remat": str(remat)}
-        if r_ca:
-            out["bottleneck"] = _bottleneck(r_ca, r_ips, batch)
-        print(json.dumps(out))
-        return
-    i_samples, i_ca = _measure(inception_v1(format="NHWC"), batch)
-    i_ips, i_spread = _stats(i_samples)
 
     out = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(r_ips, 1),
         "unit": "images/sec",
         "vs_baseline": round(r_ips / BASELINE_IMAGES_PER_SEC, 3),
+        "best_window": round(max(r_samples), 1),  # r2/r3 metric bridge
         "spread": r_spread,
-        "inception_v1_images_per_sec_per_chip": round(i_ips, 1),
-        "inception_spread": i_spread,
+        "toolchain": _toolchain(),
+        "timing_path": r_path,
         "config": f"NHWC/bf16/batch{batch}/donated"
                   + (f"/remat-{remat}" if remat else ""),
     }
-    if r_ca:
+    if "error" in r_ca:
+        out["cost_analysis_error"] = r_ca["error"]
+    else:
         out["mfu"] = round(r_ips * (r_ca["flops"] / batch)
                            / PEAK_BF16_FLOPS, 4)
         out["bottleneck"] = _bottleneck(r_ca, r_ips, batch)
-    if i_ca:
-        out["inception_mfu"] = round(i_ips * (i_ca["flops"] / batch)
-                                     / PEAK_BF16_FLOPS, 4)
-        out["inception_bottleneck"] = _bottleneck(i_ca, i_ips, batch)
-    sc = _scaling_efficiency()
-    if sc is not None:
-        out["scaling_efficiency"] = sc["value"]
-        out["scaling_detail"] = sc["images_per_sec"]
-        out["scaling_gate_0p6"] = "pass" if sc["value"] >= 0.6 else "FAIL"
-    else:
-        # a crashed child must read as a failed gate, not a missing key
-        out["scaling_efficiency"] = None
-        out["scaling_gate_0p6"] = "FAIL"
-        out["scaling_error"] = "scaling child subprocess failed"
+    if "--resnet-only" in argv:
+        print(json.dumps(out))
+        return
+
+    def emit(prefix, metric_key, samples, ca, path, units_per_step):
+        ups, spread = _stats(samples)
+        out[metric_key] = round(ups, 1)
+        out[f"{prefix}_best_window"] = round(max(samples), 1)
+        out[f"{prefix}_spread"] = spread
+        if "error" in ca:
+            out[f"{prefix}_cost_analysis_error"] = ca["error"]
+        else:
+            out[f"{prefix}_mfu"] = round(
+                ups * (ca["flops"] / units_per_step) / PEAK_BF16_FLOPS, 4)
+            out[f"{prefix}_bottleneck"] = _bottleneck(
+                ca, ups, units_per_step)
+        if path != "aot":
+            out[f"{prefix}_timing_path"] = path
+
+    def emit_guarded(prefix, metric_key, units_per_step, measure):
+        """A secondary model's failure must not discard the primary
+        metrics already measured (the r4 lost-capture failure mode)."""
+        try:
+            samples, ca, path = measure()
+            emit(prefix, metric_key, samples, ca, path, units_per_step)
+        except Exception as e:
+            out[f"{prefix}_error"] = f"{type(e).__name__}: {e}"
+
+    emit_guarded(
+        "inception", "inception_v1_images_per_sec_per_chip", batch,
+        lambda: _measure(inception_v1(format="NHWC"), batch, windows,
+                         iters))
+
+    # reference perf-driver menu breadth (DistriOptimizerPerf.scala:56-140
+    # offers vgg16 alongside the conv nets; a recurrent model rounds out
+    # the compiler-regression coverage: conv-heavy vs scan-heavy)
+    import jax.numpy as jnp
+    from bigdl_tpu import nn as _nn
+    from bigdl_tpu.models.vgg import vgg16
+    from bigdl_tpu.models.rnn import ptb_model
+
+    v_batch = 128  # NCHW (the model's native layout; fc head at 7x7)
+    rng = np.random.default_rng(2)
+    vx = jnp.asarray(rng.normal(0, 1, (v_batch, 3, 224, 224))
+                     .astype(np.float32))
+    vy = jnp.asarray(rng.integers(0, 1000, (v_batch,)).astype(np.int32))
+    emit_guarded(
+        "vgg16", "vgg16_images_per_sec_per_chip", v_batch,
+        lambda: _measure(vgg16(), v_batch, windows, iters, x=vx, y=vy))
+
+    # PTB "medium" LSTM: vocab 10k, 650x2, seq 35, batch 20 — words/sec
+    p_batch, seq = 20, 35
+    px = jnp.asarray(rng.integers(0, 10000, (p_batch, seq))
+                     .astype(np.int32))
+    py = jnp.asarray(rng.integers(0, 10000, (p_batch, seq))
+                     .astype(np.int32))
+    emit_guarded(
+        "ptb_lstm", "ptb_lstm_words_per_sec_per_chip", p_batch * seq,
+        lambda: _measure(
+            ptb_model(10000, 650, 650, 2), p_batch, windows, iters,
+            x=px, y=py,
+            criterion=_nn.TimeDistributedCriterion(
+                _nn.ClassNLLCriterion()),
+            units_per_step=p_batch * seq))
+
+    if not smoke:
+        co = _collective_overhead()
+        if co is not None:
+            out.update(co)
+        else:
+            out["collective_overhead_fraction"] = None
+            out["collective_gate_0p38"] = "FAIL"
+            out["collective_error"] = "collective child subprocess failed"
+        sc = _scaling_efficiency()
+        if sc is not None:
+            out["scaling_1v8_informational"] = sc
+        else:
+            out["scaling_1v8_informational"] = {
+                "value": None, "error": "scaling child failed"}
+        out["chip_gate"] = _chip_gate()
     print(json.dumps(out))
-
-
-def scaling():
-    """Standalone scaling mode (same measurement the main entry embeds).
-
-    True multi-chip weak scaling cannot be measured on one host: the 8
-    virtual devices share the same physical cores, so contention would
-    masquerade as scaling loss.  What CAN be isolated is the overhead the
-    SPMD partitioning itself adds: run the SAME global problem (fixed
-    global batch) unsharded on 1 device vs sharded over 8 — identical
-    total CPU work, so efficiency = t(1-dev)/t(8-dev) ≈ 1 - collective/
-    partition overhead.  The real 1→32-chip ICI measurement (BASELINE
-    north star >60%) needs pod hardware the driver doesn't provide."""
-    sc = _scaling_efficiency()
-    if sc is None:
-        raise RuntimeError("scaling child failed")
-    print(json.dumps({
-        "metric": "resnet_cifar_sharding_overhead_efficiency_cpu_mesh",
-        "value": sc["value"],
-        "unit": "parallel_efficiency",
-        "images_per_sec": sc["images_per_sec"],
-    }))
 
 
 def scaling_child():
@@ -317,21 +569,102 @@ def scaling_child():
         p, os_ = method.update(g, p, os_, 0.1, it)
         return p, ms, os_, loss
 
-    params, mstate, ostate, loss = step(params, mstate, ostate, x, y, 0)
+    # warmup discipline matching the main measurement (VERDICT r4 weak#6)
+    for w in range(2):
+        params, mstate, ostate, loss = step(params, mstate, ostate, x, y, w)
     loss.block_until_ready()
-    iters = 10
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, mstate, ostate, loss = step(params, mstate, ostate, x, y, i)
+    meds = []
+    for w in range(3):
+        iters = 10
+        t0 = time.perf_counter()
+        for i in range(iters):
+            params, mstate, ostate, loss = step(params, mstate, ostate,
+                                                x, y, 2 + w * iters + i)
+        loss.block_until_ready()
+        meds.append(batch * iters / (time.perf_counter() - t0))
+    print(statistics.median(meds))
+
+
+def collective_child():
+    """Time one sharded DP training step with the gradient all-reduce
+    present ("with"), ablated ("ablated" — identical per-device compute,
+    gradients simply left unreduced so each device trains locally), or
+    with 3 extra all-reduces ("inject" — the gate's self-test).  The
+    model is the framework's own Sequential MLP sized param-heavy
+    (module-doc calibration) so the psum is visible above step noise.
+    Prints median ms/step."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from bigdl_tpu import nn, optim
+
+    mode = os.environ["_BENCH_COLL_MODE"]
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.array(devs), ("data",))
+
+    D = 2048
+    model = (nn.Sequential()
+             .add(nn.Linear(D, D)).add(nn.Tanh())
+             .add(nn.Linear(D, D)).add(nn.Tanh())
+             .add(nn.Linear(D, D)))
+    criterion = nn.MSECriterion()
+    method = optim.SGD(learning_rate=0.01, momentum=0.9)
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    ostate = method.init_state(params)
+    batch = 64  # 8/device
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (batch, D)).astype(np.float32))
+    y = jnp.asarray(rng.normal(0, 1, (batch, D)).astype(np.float32))
+
+    def loss_fn(p, ms, x, y):
+        out, ms2 = model.apply(p, ms, x, training=True)
+        return criterion.apply(out, y), ms2
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    psum = lambda t: jax.tree_util.tree_map(
+        lambda a: lax.psum(a, "data"), t)
+
+    def one_step(p, ms, os_, x, y, it):
+        (loss, ms2), g = grad_fn(p, ms, x, y)
+        if mode in ("with", "inject"):
+            g = psum(g)
+        if mode == "inject":
+            g = psum(psum(psum(g)))  # 3 artificial extra all-reduces
+        p2, os2 = method.update(g, p, os_, 0.1, it)
+        return p2, ms2, os2, loss[None]
+
+    repl = jax.tree_util.tree_map(lambda _: P(), params)
+    replm = jax.tree_util.tree_map(lambda _: P(), mstate)
+    replo = jax.tree_util.tree_map(lambda _: P(), ostate)
+    # check_vma=False: in "ablated" mode params are legitimately
+    # device-varying (that is the point of the ablation)
+    fn = jax.jit(shard_map(one_step, mesh=mesh,
+                           in_specs=(repl, replm, replo, P("data"),
+                                     P("data"), P()),
+                           out_specs=(repl, replm, replo, P("data")),
+                           check_vma=False),
+                 donate_argnums=(0, 1, 2))
+    for i in range(3):  # compile + warmup
+        params, mstate, ostate, loss = fn(params, mstate, ostate, x, y, i)
     loss.block_until_ready()
-    dt = time.perf_counter() - t0
-    print(batch * iters / dt)
+    meds = []
+    for w in range(3):
+        iters = 5
+        t0 = time.perf_counter()
+        for i in range(iters):
+            params, mstate, ostate, loss = fn(params, mstate, ostate,
+                                              x, y, 3 + w * iters + i)
+        loss.block_until_ready()
+        meds.append((time.perf_counter() - t0) / iters * 1e3)
+    print(statistics.median(meds))
 
 
 if __name__ == "__main__":
     if "--scaling-child" in sys.argv:
         scaling_child()
-    elif "--scaling" in sys.argv:
-        scaling()
+    elif "--collective-child" in sys.argv:
+        collective_child()
     else:
         main(sys.argv[1:])

@@ -1,0 +1,112 @@
+"""Toolchain-drift guard (VERDICT r4 item 7).
+
+Round 3→4 showed the environment can change under the repo between
+rounds (jax 0.8→0.9 recompiled identical source to +6.4 GB/step and
+nothing noticed in-round), and a harness regression (a silently
+swallowed cost-analysis failure) shipped a BENCH capture with half the
+deliverable missing.  These tests make both failure modes loud:
+
+- the jax version floor and the shard_map API shape this repo depends
+  on (``from jax import shard_map`` + ``check_vma=``) are asserted, so
+  the next upgrade fails CI instead of silently changing semantics;
+- the real accelerator's presence is asserted (subprocess probe — this
+  suite itself pins CPU by design, ``conftest.py``);
+- ``bench.py --resnet-only --smoke`` must emit a JSON with EVERY key
+  the round deliverable needs, including the roofline fields whose
+  silent loss was r4's headline integrity failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)       # no CPU-mesh device-count leak
+    env.pop("JAX_PLATFORMS", None)   # children choose the real platform
+    return env
+
+
+def test_jax_version_floor():
+    import jax
+    import jaxlib
+    ver = tuple(int(p) for p in jax.__version__.split(".")[:2])
+    assert ver >= (0, 9), (
+        f"jax {jax.__version__} < 0.9: bench numbers and the shard_map "
+        f"API contract were calibrated under 0.9 — recalibrate before "
+        f"trusting a BENCH capture from this environment")
+    assert jaxlib.__version__.split(".")[:2] == \
+        jax.__version__.split(".")[:2], "jax/jaxlib version skew"
+
+
+def test_shard_map_api_shape():
+    # the repo-wide import path and kwarg (parallel/pipeline.py,
+    # bench.py collective child): jax>=0.8 renamed check_rep→check_vma
+    from jax import shard_map
+    import inspect
+    params = inspect.signature(shard_map).parameters
+    assert "check_vma" in params, list(params)
+    assert "mesh" in params and "in_specs" in params \
+        and "out_specs" in params
+
+
+def test_real_accelerator_present():
+    """The driver's bench runs on the real chip; if the tunnel is gone,
+    every throughput number silently becomes a CPU number.  Probe in a
+    subprocess (this process is CPU-pinned by conftest)."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices()[0]; "
+         "print(d.platform, getattr(d, 'device_kind', '?'))"],
+        capture_output=True, text=True, timeout=180, env=_clean_env())
+    assert r.returncode == 0, r.stderr[-1000:]
+    platform = r.stdout.strip().split()[0] if r.stdout.strip() else "?"
+    if platform != "tpu":
+        pytest.skip(f"no TPU attached (platform={platform}) — bench "
+                    f"numbers from this machine are not chip numbers")
+
+
+# every key a BENCH_r* capture is contractually required to carry;
+# `bottleneck`/`mfu` may be replaced by cost_analysis_error — but that
+# substitution must be LOUD (asserted below), never a silent drop
+_SMOKE_KEYS = {"metric", "value", "unit", "vs_baseline", "best_window",
+               "spread", "toolchain", "timing_path", "config"}
+_SPREAD_KEYS = {"median", "min", "max", "rel_spread", "windows"}
+_TOOLCHAIN_KEYS = {"jax", "jaxlib", "platform", "device_kind"}
+
+
+def test_bench_smoke_emits_full_contract():
+    """1-window/4-iter smoke run of the real bench entry (on the real
+    chip when attached).  A field-dropping harness regression fails
+    HERE instead of shipping inside a round's BENCH capture."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"),
+         "--resnet-only", "--smoke"],
+        capture_output=True, text=True, timeout=900, env=_clean_env())
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = r.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+
+    missing = _SMOKE_KEYS - out.keys()
+    assert not missing, f"bench smoke JSON lost keys: {sorted(missing)}"
+    assert _SPREAD_KEYS <= out["spread"].keys()
+    assert _TOOLCHAIN_KEYS <= out["toolchain"].keys()
+
+    if "cost_analysis_error" in out:
+        # the loud-failure path: allowed by the schema, but it IS a
+        # contract failure for a round capture — surface the message
+        raise AssertionError(
+            f"cost analysis failed (loudly, as designed): "
+            f"{out['cost_analysis_error']}")
+    assert out["timing_path"] == "aot"
+    assert {"mfu", "bottleneck"} <= out.keys()
+    assert {"kind", "xla_flops_G", "xla_bytes_GB", "t_mxu_floor_ms",
+            "t_hbm_floor_ms", "t_measured_ms",
+            "hbm_floor_fraction"} <= out["bottleneck"].keys()
+    assert out["value"] > 0 and out["best_window"] >= out["value"]
