@@ -24,6 +24,11 @@ bytes/step 37.3→80.4 GB).  Until pallas grows input-layout control,
 kernel is opt-in (``impl="pallas_bwd"``), retained as the reference
 first-match implementation and for layout-friendly call-sites.
 
+r5 addendum (jax 0.9): 0.9 did NOT gain pallas input-layout control —
+the copy penalty stands — and its Mosaic additionally fails to compile
+the large-spatial blocks that 0.8 accepted (see :func:`supported`,
+which now gates on a measured 2 MiB per-block budget and falls back).
+
 Mosaic lowering constraints discovered on v5e, which shape the design:
 - no scatter-add; no rank-changing vector reshapes; strided vector
   loads/stores don't lower for bf16 (sublane-packed) or >128 lanes.
@@ -91,10 +96,22 @@ def _bwd_kernel(x_ref, y_ref, g_ref, gi_ref, taken_ref, *, kh, kw, sh, sw,
 
 
 def supported(x_shape, kernel, stride, pads):
-    """Whether the pallas backward covers this pooling config."""
+    """Whether the pallas backward covers this pooling config.
+
+    Besides the structural conditions, a per-block VMEM budget gate:
+    jax 0.9's Mosaic aborts compilation (axon compile-helper exit 1,
+    no diagnostic) for the large-spatial blocks that compiled fine
+    under 0.8 — measured on v5e: input blocks of 3.2 MB (112²×64 s2,
+    56²×192 s2) fail, 1.6 MB (28²×480 s2) and below compile.  Gate at
+    2 MiB so those sites silently take the documented reduce_window
+    fallback instead of a runtime compile error."""
     _, H, W, C = x_shape
     (kh, kw), (sh, sw) = kernel, stride
-    return H % sh == 0 and W % sw == 0 and kh >= sh and kw >= sw
+    if not (H % sh == 0 and W % sw == 0 and kh >= sh and kw >= sw):
+        return False
+    C_eff = C if C <= 128 else -(-C // 128) * 128
+    block_bytes = (H // sh) * sh * (W // sw) * sw * C_eff * 4
+    return block_bytes <= 2 * 1024 * 1024
 
 
 def maxpool_bwd_nhwc(x, y, g, kernel, stride, pads):

@@ -90,6 +90,46 @@ class TestNewAugmentations:
         assert out.image.shape[:2] == (48, 12)
 
 
+class TestPallasPoolVmemGate:
+    def test_supported_gates_large_spatial_blocks(self):
+        # jax-0.9 Mosaic rejects the 3.2MB blocks that 0.8 compiled
+        # (measured on v5e, see pallas_pool.supported docstring);
+        # the gate must route those to the reduce_window fallback
+        from bigdl_tpu.ops.pallas_pool import supported
+        k, s = (3, 3), (2, 2)
+        pads = ((0, 1), (0, 1))
+        assert not supported((256, 112, 112, 64), k, s, pads)
+        assert not supported((256, 56, 56, 192), k, s, pads)
+        assert supported((256, 28, 28, 480), k, s, pads)
+        assert supported((256, 14, 14, 832), k, s, pads)
+        # structural rejections unchanged
+        assert not supported((256, 28, 28, 64), (2, 2), (3, 3), pads)
+
+    def test_fallback_path_still_correct(self):
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu.ops.pallas_pool import (
+            maxpool_nhwc_with_pallas_bwd, supported)
+        rng = np.random.default_rng(0)
+        # a VMEM-gated shape (64*64*256*4 = 4MB block > 2MB): must
+        # silently take reduce_window fwd + select-and-scatter bwd
+        shape = (2, 64, 64, 192)
+        dims, strides = (1, 3, 3, 1), (1, 2, 2, 1)
+        pads = ((0, 0), (0, 1), (0, 1), (0, 0))
+        assert not supported(shape, (3, 3), (2, 2), (pads[1], pads[2]))
+        x = jnp.asarray(rng.normal(0, 1, shape).astype(np.float32))
+
+        def f(x):
+            return maxpool_nhwc_with_pallas_bwd(
+                x, dims, strides, pads).sum()
+
+        y, g = jax.value_and_grad(f)(x)
+        want = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims,
+                                     strides, pads)
+        np.testing.assert_allclose(float(y), float(want.sum()), rtol=1e-6)
+        assert g.shape == x.shape and np.isfinite(np.asarray(g)).all()
+
+
 class TestAdvisorFixes:
     def test_convlstm3d_checkpoint_guard(self):
         from bigdl_tpu.nn.recurrent import ConvLSTMPeephole3D

@@ -12,11 +12,13 @@ Usage:
 
 Prints:
 - cost_analysis totals (flops, bytes) + roofline floors;
-- per-opcode aggregate of OUTPUT buffer bytes across the optimized HLO
-  (a traffic proxy: every materialized buffer is written once and read
-  at least once — fusions' internal values don't appear, which is
-  exactly what makes the externally-visible buffers the interesting
-  set);
+- per-opcode aggregate of bytes ACCESSED (output write + operand
+  reads) over the ENTRY computation of the optimized HLO — fusion
+  bodies' internal values never materialize and are excluded, which is
+  exactly what makes the entry-visible buffers the interesting set.
+  This parses untiled logical shapes, so totals undercount the cost
+  model (which charges padded/tiled layouts); use it for RELATIVE
+  attribution between two runs, with cost_analysis as ground truth;
 - the top-N largest single instructions with their opcodes/shapes.
 
 Comparing two runs of this tool (different jax versions, layouts,
@@ -63,16 +65,48 @@ _INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.+?)\s+(\w+)\(")
 
 
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+
 def audit(hlo_text: str, top: int):
-    """Aggregate output-buffer bytes by opcode over the optimized HLO."""
-    by_op = defaultdict(int)
-    instrs = []
+    """Aggregate bytes ACCESSED (output write + operand reads) by opcode
+    over the optimized HLO's ENTRY computation only — nested
+    computations (fusion bodies, reduce bodies) describe values that
+    never materialize in HBM and would wildly overcount if parsed.
+    This mirrors XLA cost analysis' accounting, which sums operand +
+    output sizes per top-level instruction."""
+    # pass 1: entry instruction shapes (for operand lookups)
+    entry_lines = []
+    in_entry = False
     for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry and line.startswith("}"):
+            in_entry = False
+        if in_entry:
+            entry_lines.append(line)
+    out_bytes = {}
+    parsed = []
+    for line in entry_lines:
         m = _INSTR_RE.match(line)
         if not m:
             continue
         name, shape_str, opcode = m.groups()
-        b = shape_bytes(shape_str)
+        out_bytes[name] = shape_bytes(shape_str)
+        parsed.append((line, name, shape_str, opcode))
+
+    by_op = defaultdict(int)
+    instrs = []
+    for line, name, shape_str, opcode in parsed:
+        b = out_bytes[name]
+        # operand reads: %refs in the argument list that name entry
+        # instructions.  Cut at the closing paren — attributes after it
+        # (control-predecessors={...}, calls=%fused...) also hold %refs
+        # but are not reads
+        args = line.split(opcode + "(", 1)[-1].split(")")[0]
+        for ref in _OPERAND_RE.findall(args):
+            b += out_bytes.get(ref, 0)
         if b == 0:
             continue
         # fusion kinds matter more than the generic "fusion" opcode
@@ -146,7 +180,8 @@ def main():
 
     hlo = compiled.as_text()
     by_op, top_instrs = audit(hlo, args.top)
-    print("\n-- output-buffer bytes by opcode (GB) --")
+    print("\n-- entry bytes accessed (write + operand reads, untiled) "
+          "by opcode (GB) --")
     for op, b in sorted(by_op.items(), key=lambda kv: -kv[1]):
         if b > 50e6:
             print(f"  {op:28s} {b/1e9:8.3f}")
