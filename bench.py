@@ -493,7 +493,12 @@ def main(argv):
         "vgg16", "vgg16_images_per_sec_per_chip", v_batch,
         lambda: _measure(vgg16(), v_batch, windows, iters, x=vx, y=vy))
 
-    # PTB "medium" LSTM: vocab 10k, 650x2, seq 35, batch 20 — words/sec
+    # PTB "medium" LSTM: vocab 10k, 650x2, seq 35, batch 20 — words/sec.
+    # scan_unroll=5: the r5 sweep on this chip (hoisted input
+    # projections active in all rows) measured words/s of 55.3k@1,
+    # 59.5k@3, 76.5k@5, 49.0k@7, 58.2k@9, 55.1k@35 — full unroll loses
+    # loop-invariant hoisting (bytes 1.58→3.32 GB).  Pre-optimization
+    # baseline (no hoist, no unroll): 31.3k.
     p_batch, seq = 20, 35
     px = jnp.asarray(rng.integers(0, 10000, (p_batch, seq))
                      .astype(np.int32))
@@ -502,8 +507,8 @@ def main(argv):
     emit_guarded(
         "ptb_lstm", "ptb_lstm_words_per_sec_per_chip", p_batch * seq,
         lambda: _measure(
-            ptb_model(10000, 650, 650, 2), p_batch, windows, iters,
-            x=px, y=py,
+            ptb_model(10000, 650, 650, 2, scan_unroll=5), p_batch,
+            windows, iters, x=px, y=py,
             criterion=_nn.TimeDistributedCriterion(
                 _nn.ClassNLLCriterion()),
             units_per_step=p_batch * seq))

@@ -25,16 +25,20 @@ def simple_rnn(input_size: int = 128, hidden_size: int = 40,
 
 def ptb_model(vocab_size: int = 10000, embed_dim: int = 200,
               hidden_size: int = 200, num_layers: int = 2,
-              dropout: float = 0.0) -> nn.Sequential:
+              dropout: float = 0.0,
+              scan_unroll: int = 1) -> nn.Sequential:
     """PTB word LM (reference ``PTBModel.scala``): embedding → stacked LSTM
-    → per-step Linear → LogSoftMax.  Input: int tokens (N, T)."""
+    → per-step Linear → LogSoftMax.  Input: int tokens (N, T).
+
+    ``scan_unroll`` unrolls the time loop (exact math) — small-batch
+    LSTM steps are dispatch-bound on TPU; see Recurrent's docstring."""
     cells = [LSTM(embed_dim if i == 0 else hidden_size, hidden_size)
              for i in range(num_layers)]
     m = (nn.Sequential(name="PTBModel")
          .add(nn.LookupTable(vocab_size, embed_dim)))
     if dropout > 0:
         m.add(nn.Dropout(dropout))
-    m.add(Recurrent(MultiRNNCell(cells)))
+    m.add(Recurrent(MultiRNNCell(cells), unroll=scan_unroll))
     if dropout > 0:
         m.add(nn.Dropout(dropout))
     m.add(TimeDistributed(nn.Linear(hidden_size, vocab_size)))

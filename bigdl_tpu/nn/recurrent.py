@@ -49,6 +49,27 @@ class Cell(Module):
     def step(self, params, x_t, hidden):
         raise NotImplementedError
 
+    # -- optional scan optimization (TPU) -------------------------------
+    # The input-side projection x_t @ W_x has no sequential dependency,
+    # so a cell may expose it for hoisting: ``Recurrent`` then computes
+    # it for ALL timesteps as ONE large MXU-efficient matmul
+    # ((T*N, D) @ (D, 4H)) and the scan body keeps only the h-side
+    # matmul — roughly halving the work trapped inside the sequential
+    # loop, which is where small-batch RNNs spend their time on TPU.
+    # Numerics: x@Wx + h@Wh sums the D and H reduction axes separately
+    # instead of as one (D+H) reduction — a reassociation within normal
+    # float tolerance of the fused form.
+
+    def hoist(self, params, xs):
+        """Precompute the input projections for a (T, N, ...) sequence;
+        return the per-step pytree to scan over, or None when this cell
+        has no hoistable form (the default)."""
+        return None
+
+    def step_hoisted(self, params, zx_t, hidden):
+        """``step`` consuming a :meth:`hoist` slice instead of x_t."""
+        raise NotImplementedError
+
     # a Cell used standalone acts on one timestep: input=(x_t, hidden)
     def apply(self, params, state, input, *, training=False, rng=None):
         x_t, hidden = input
@@ -85,6 +106,13 @@ class RnnCell(Cell):
                                 + params["bias"])
         return h_new, h_new
 
+    def hoist(self, params, xs):
+        return xs @ params["w_ih"].T + params["bias"]
+
+    def step_hoisted(self, params, zx_t, h):
+        h_new = self.activation(zx_t + h @ params["w_hh"].T)
+        return h_new, h_new
+
 
 class LSTM(Cell):
     """LSTM cell (reference ``LSTM.scala``): gates i,f,g,o from one fused
@@ -111,9 +139,11 @@ class LSTM(Cell):
 
     def step(self, params, x_t, hidden):
         h, c = hidden
-        H = self.hidden_size
         z = jnp.concatenate([x_t, h], axis=-1) @ params["weight"].T \
             + params["bias"]
+        return self._gates(z, c)
+
+    def _gates(self, z, c):
         i, f, g, o = jnp.split(z, 4, axis=-1)
         i = jax.nn.sigmoid(i)
         f = jax.nn.sigmoid(f + self.forget_bias)
@@ -122,6 +152,17 @@ class LSTM(Cell):
         c_new = f * c + i * g
         h_new = o * jnp.tanh(c_new)
         return h_new, (h_new, c_new)
+
+    def hoist(self, params, xs):
+        D = self.input_size
+        return xs @ params["weight"][:, :D].T + params["bias"]
+
+    def step_hoisted(self, params, zx_t, hidden):
+        h, c = hidden
+        # the loop-invariant W_h slice is hoisted out of the scan by
+        # XLA's while-loop invariant code motion
+        z = zx_t + h @ params["weight"][:, self.input_size:].T
+        return self._gates(z, c)
 
 
 class LSTMPeephole(Cell):
@@ -186,6 +227,20 @@ class GRU(Cell):
         r, u = jnp.split(jax.nn.sigmoid(z), 2, axis=-1)
         cand = jnp.tanh(jnp.concatenate([x_t, r * h], axis=-1)
                         @ params["w_cand"].T + params["b_cand"])
+        h_new = u * h + (1 - u) * cand
+        return h_new, h_new
+
+    def hoist(self, params, xs):
+        D = self.input_size
+        return (xs @ params["w_gates"][:, :D].T + params["b_gates"],
+                xs @ params["w_cand"][:, :D].T + params["b_cand"])
+
+    def step_hoisted(self, params, zx_t, h):
+        zg, zc = zx_t
+        D = self.input_size
+        z = zg + h @ params["w_gates"][:, D:].T
+        r, u = jnp.split(jax.nn.sigmoid(z), 2, axis=-1)
+        cand = jnp.tanh(zc + (r * h) @ params["w_cand"][:, D:].T)
         h_new = u * h + (1 - u) * cand
         return h_new, h_new
 
@@ -352,16 +407,39 @@ class MultiRNNCell(Cell):
             new_hidden.append(h)
         return out, tuple(new_hidden)
 
+    def hoist(self, params, xs):
+        # only layer 0 sees the raw sequence; deeper layers consume
+        # in-loop outputs, so their projections cannot move out
+        return self.cells[0].hoist(params["0"], xs)
+
+    def step_hoisted(self, params, zx_t, hidden):
+        new_hidden = []
+        out, h = self.cells[0].step_hoisted(params["0"], zx_t, hidden[0])
+        new_hidden.append(h)
+        for i, c in enumerate(self.cells[1:], start=1):
+            out, h = c.step(params[str(i)], out, hidden[i])
+            new_hidden.append(h)
+        return out, tuple(new_hidden)
+
 
 class Recurrent(Module):
     """Run a Cell over the time dim of (N, T, ...) via ``lax.scan``
-    (reference ``Recurrent.scala``; returns the full output sequence)."""
+    (reference ``Recurrent.scala``; returns the full output sequence).
+
+    TPU scan discipline: the input-side projections are hoisted out of
+    the loop when the cell supports it (see :meth:`Cell.hoist` — one
+    large MXU matmul replaces T small ones), and ``unroll`` is passed to
+    ``lax.scan`` — small-batch RNN steps are dispatch-bound on TPU, so
+    unrolling the loop body amortizes per-iteration overhead (measured
+    on the PTB bench; see bench.py).  Both are exact-math
+    transformations (hoisting reassociates one float reduction)."""
 
     def __init__(self, cell: Cell, reverse: bool = False,
-                 name: Optional[str] = None):
+                 unroll: int = 1, name: Optional[str] = None):
         super().__init__(name)
         self.cell = cell
         self.reverse = reverse
+        self.unroll = unroll
 
     def spec_children(self):
         return self.cell
@@ -376,11 +454,18 @@ class Recurrent(Module):
         if self.reverse:
             xs = jnp.flip(xs, axis=0)
 
-        def body(hidden, x_t):
-            y, new_hidden = self.cell.step(params, x_t, hidden)
-            return new_hidden, y
-
-        _, ys = lax.scan(body, hidden0, xs)
+        zx = self.cell.hoist(params, xs)
+        if zx is not None:
+            def body(hidden, zx_t):
+                y, new_hidden = self.cell.step_hoisted(params, zx_t,
+                                                       hidden)
+                return new_hidden, y
+            _, ys = lax.scan(body, hidden0, zx, unroll=self.unroll)
+        else:
+            def body(hidden, x_t):
+                y, new_hidden = self.cell.step(params, x_t, hidden)
+                return new_hidden, y
+            _, ys = lax.scan(body, hidden0, xs, unroll=self.unroll)
         if self.reverse:
             ys = jnp.flip(ys, axis=0)
         return jnp.moveaxis(ys, 0, 1), state  # back to (N, T, ...)

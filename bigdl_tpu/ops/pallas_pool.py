@@ -27,7 +27,8 @@ first-match implementation and for layout-friendly call-sites.
 r5 addendum (jax 0.9): 0.9 did NOT gain pallas input-layout control —
 the copy penalty stands — and its Mosaic additionally fails to compile
 the large-spatial blocks that 0.8 accepted (see :func:`supported`,
-which now gates on a measured 2 MiB per-block budget and falls back).
+which now gates on a measured 410K per-block ELEMENT budget and
+falls back).
 
 Mosaic lowering constraints discovered on v5e, which shape the design:
 - no scatter-add; no rank-changing vector reshapes; strided vector
@@ -95,23 +96,32 @@ def _bwd_kernel(x_ref, y_ref, g_ref, gi_ref, taken_ref, *, kh, kw, sh, sw,
                    j0 + qw:j1 + qw, rw * C:(rw + 1) * C] = cur + contrib
 
 
+def _lane_pad(C: int) -> int:
+    """Channels after vreg lane alignment (shared with the kernel's
+    padding rule in :func:`maxpool_bwd_nhwc`)."""
+    return C if C <= 128 else -(-C // 128) * 128
+
+
 def supported(x_shape, kernel, stride, pads):
     """Whether the pallas backward covers this pooling config.
 
-    Besides the structural conditions, a per-block VMEM budget gate:
-    jax 0.9's Mosaic aborts compilation (axon compile-helper exit 1,
-    no diagnostic) for the large-spatial blocks that compiled fine
-    under 0.8 — measured on v5e: input blocks of 3.2 MB (112²×64 s2,
-    56²×192 s2) fail, 1.6 MB (28²×480 s2) and below compile.  Gate at
-    2 MiB so those sites silently take the documented reduce_window
-    fallback instead of a runtime compile error."""
+    Besides the structural conditions, a per-block ELEMENT budget gate:
+    jax 0.9's Mosaic aborts compilation (axon compile-helper exit 1, no
+    diagnostic) for the large-spatial blocks that compiled fine under
+    0.8.  The limit is element count, not bytes — measured on v5e:
+    802,816-element blocks fail in BOTH f32 (112²×64, 56²×192) and
+    bf16 (112²×64, i.e. half the bytes), while 401,408-element blocks
+    (28²×480-pad-512, 56²×128) compile in both dtypes — consistent
+    with bf16's (2,1) sublane packing keeping vreg footprint
+    proportional to elements.  Gate at 410,000 elements (just above
+    the largest measured-good block) so bigger sites silently take the
+    documented reduce_window fallback instead of a runtime compile
+    error."""
     _, H, W, C = x_shape
     (kh, kw), (sh, sw) = kernel, stride
     if not (H % sh == 0 and W % sw == 0 and kh >= sh and kw >= sw):
         return False
-    C_eff = C if C <= 128 else -(-C // 128) * 128
-    block_bytes = (H // sh) * sh * (W // sw) * sw * C_eff * 4
-    return block_bytes <= 2 * 1024 * 1024
+    return H * W * _lane_pad(C) <= 410_000
 
 
 def maxpool_bwd_nhwc(x, y, g, kernel, stride, pads):
@@ -128,7 +138,7 @@ def maxpool_bwd_nhwc(x, y, g, kernel, stride, pads):
     # lane alignment: pad channels to a 128 multiple so every lane
     # slice in the kernel is vreg-aligned (only the branchy concat
     # widths 192/480/528/832 pay this, and those tensors are small)
-    C_eff = C if C <= 128 else -(-C // 128) * 128
+    C_eff = _lane_pad(C)
     if C_eff != C:
         x = jnp.pad(x, ((0, 0),) * 3 + ((0, C_eff - C),),
                     constant_values=-jnp.inf)

@@ -16,6 +16,7 @@ deliverable missing.  These tests make both failure modes loud:
   silent loss was r4's headline integrity failure.
 """
 
+import functools
 import json
 import os
 import subprocess
@@ -56,6 +57,7 @@ def test_shard_map_api_shape():
         and "out_specs" in params
 
 
+@functools.lru_cache(maxsize=1)
 def _probe_platform():
     r = subprocess.run(
         [sys.executable, "-c",

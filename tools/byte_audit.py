@@ -16,8 +16,9 @@ Prints:
   reads) over the ENTRY computation of the optimized HLO — fusion
   bodies' internal values never materialize and are excluded, which is
   exactly what makes the entry-visible buffers the interesting set.
-  This parses untiled logical shapes, so totals undercount the cost
-  model (which charges padded/tiled layouts); use it for RELATIVE
+  This parses untiled logical shapes and cannot see aliasing (async
+  wrappers re-counting their wrapped op, tuple pass-through), so
+  totals will NOT equal the cost model's; use it for RELATIVE
   attribution between two runs, with cost_analysis as ground truth;
 - the top-N largest single instructions with their opcodes/shapes.
 
@@ -60,12 +61,17 @@ def shape_bytes(shape_str: str) -> int:
 
 
 # shape part may be a single shape OR a tuple with internal spaces
-# ("(bf16[...]{...}, f32[...]{...})") — lazy-match up to the opcode token
+# ("(bf16[...]{...}, f32[...]{...})") — lazy-match up to the opcode
+# token, which may be hyphenated (get-tuple-element, custom-call,
+# dynamic-update-slice, all-reduce)
 _INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.+?)\s+(\w+)\(")
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.+?)\s+([\w-]+)\(")
 
 
-_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+# '%' is optional: some as_text() formats print operands without the
+# sigil (mirrors _INSTR_RE's optional '%' on definitions); resolution
+# against out_bytes keys filters non-operand tokens either way
+_OPERAND_RE = re.compile(r"%?([\w.\-]+)")
 
 
 def audit(hlo_text: str, top: int):
@@ -96,9 +102,14 @@ def audit(hlo_text: str, top: int):
         out_bytes[name] = shape_bytes(shape_str)
         parsed.append((line, name, shape_str, opcode))
 
+    # aliasing/bookkeeping ops move no bytes themselves but must stay
+    # resolvable as operands of real consumers
+    no_traffic = {"get-tuple-element", "tuple", "bitcast", "parameter"}
     by_op = defaultdict(int)
     instrs = []
     for line, name, shape_str, opcode in parsed:
+        if opcode in no_traffic:
+            continue
         b = out_bytes[name]
         # operand reads: %refs in the argument list that name entry
         # instructions.  Cut at the closing paren — attributes after it
