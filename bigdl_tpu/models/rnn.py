@@ -13,12 +13,14 @@ from bigdl_tpu.nn.recurrent import (
 
 
 def simple_rnn(input_size: int = 128, hidden_size: int = 40,
-               output_size: int = 128) -> nn.Sequential:
+               output_size: int = 128,
+               scan_unroll: int = 1) -> nn.Sequential:
     """Char-level RNN (reference ``SimpleRNN.scala``): one-hot input
     (N, T, input_size) → Recurrent(RnnCell) → per-step Linear →
     LogSoftMax."""
     return (nn.Sequential(name="SimpleRNN")
-            .add(Recurrent(RnnCell(input_size, hidden_size)))
+            .add(Recurrent(RnnCell(input_size, hidden_size),
+                           unroll=scan_unroll))
             .add(TimeDistributed(nn.Linear(hidden_size, output_size)))
             .add(nn.LogSoftMax()))
 

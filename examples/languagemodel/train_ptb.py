@@ -32,6 +32,9 @@ def main():
     p.add_argument("--vocab", type=int, default=200)
     p.add_argument("--hidden", type=int, default=64)
     p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--scan-unroll", type=int, default=1,
+                   help="unroll the time loop (exact math; ~2x on TPU "
+                        "at unroll 5 for the PTB config, see bench.py)")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
     if args.cpu:
@@ -64,7 +67,8 @@ def main():
 
     vocab = dictionary.vocab_size()
     model = ptb_model(vocab_size=vocab, embed_dim=args.hidden,
-                      hidden_size=args.hidden, num_layers=args.layers)
+                      hidden_size=args.hidden, num_layers=args.layers,
+                      scan_unroll=args.scan_unroll)
     criterion = nn.TimeDistributedCriterion(
         nn.CrossEntropyCriterion(), size_average=True)
     optimizer = (optim.LocalOptimizer(model, ds, criterion)

@@ -30,6 +30,9 @@ def main():
     p.add_argument("--vocab-size", type=int, default=10000)
     p.add_argument("--hidden-size", type=int, default=200)
     p.add_argument("--learning-rate", type=float, default=0.005)
+    p.add_argument("--scan-unroll", type=int, default=1,
+                   help="unroll the time loop (exact math; ~2x on TPU "
+                        "at unroll 5 for the PTB config, see bench.py)")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
 
@@ -63,10 +66,12 @@ def main():
     vocab = d.vocab_size()
     if args.model == "ptb":
         model = ptb_model(vocab_size=vocab, embed_dim=args.hidden_size,
-                          hidden_size=args.hidden_size)
+                          hidden_size=args.hidden_size,
+                          scan_unroll=args.scan_unroll)
     else:
         model = simple_rnn(input_size=vocab, hidden_size=args.hidden_size,
-                           output_size=vocab)
+                           output_size=vocab,
+                           scan_unroll=args.scan_unroll)
 
     # models end in LogSoftMax -> NLL per step (reference PTBWordLM pairs
     # TimeDistributedCriterion with CrossEntropy on raw outputs instead)
