@@ -497,8 +497,11 @@ def main(argv):
     # scan_unroll=5: the r5 sweep on this chip (hoisted input
     # projections active in all rows) measured words/s of 55.3k@1,
     # 59.5k@3, 76.5k@5, 49.0k@7, 58.2k@9, 55.1k@35 — full unroll loses
-    # loop-invariant hoisting (bytes 1.58→3.32 GB).  Pre-optimization
-    # baseline (no hoist, no unroll): 31.3k.
+    # loop-invariant hoisting (bytes 1.58→3.32 GB).  (Sweep absolutes
+    # read low from host contention; the uncontended r5 capture
+    # measured 144.8k median at this config.)  Pre-optimization
+    # baseline (no hoist, no unroll): 31.3k.  Expect a wide rel_spread:
+    # at 4.8 ms/step the number is host-dispatch sensitive.
     p_batch, seq = 20, 35
     px = jnp.asarray(rng.integers(0, 10000, (p_batch, seq))
                      .astype(np.int32))
