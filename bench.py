@@ -499,14 +499,15 @@ def main(argv):
         lambda: _measure(vgg16(), v_batch, windows, iters, x=vx, y=vy))
 
     # PTB "medium" LSTM: vocab 10k, 650x2, seq 35, batch 20 — words/sec.
-    # scan_unroll=5: the r5 sweep on this chip (hoisted input
-    # projections active in all rows) measured words/s of 55.3k@1,
-    # 59.5k@3, 76.5k@5, 49.0k@7, 58.2k@9, 55.1k@35 — full unroll loses
-    # loop-invariant hoisting (bytes 1.58→3.32 GB).  (Sweep absolutes
-    # read low from host contention; the uncontended r5 capture
-    # measured 144.8k median at this config.)  Pre-optimization
-    # baseline (no hoist, no unroll): 31.3k.  Expect a wide rel_spread:
-    # at 4.8 ms/step the number is host-dispatch sensitive.
+    # scan_unroll=5, chosen by the r5 sweeps (hoisted input projections
+    # active in all rows): unroll 1 < {5, 7} consistently; 5 vs 7 are
+    # within each other's spread; full unroll (35) loses loop-invariant
+    # hoisting (bytes 1.58→3.32 GB) and regresses.  Pre-optimization
+    # baseline (no hoist, no unroll): 31.3k words/s; optimized
+    # measurements ranged 145k-280k median across host states.  This
+    # number is host-dispatch sensitive (steps are ~3-5 ms): the 4x
+    # iters below lengthen windows to ~0.6 s, and the reported spread
+    # is the honesty mechanism — judge the number with it.
     p_batch, seq = 20, 35
     px = jnp.asarray(rng.integers(0, 10000, (p_batch, seq))
                      .astype(np.int32))
@@ -514,9 +515,12 @@ def main(argv):
                      .astype(np.int32))
     emit_guarded(
         "ptb_lstm", "ptb_lstm_words_per_sec_per_chip", p_batch * seq,
+        # 4x iters: at ~5 ms/step a 32-iter window is only ~150 ms and
+        # host jitter alone produced rel_spread 0.34; ~0.6 s windows
+        # put the spread back in the same regime as the conv models
         lambda: _measure(
             ptb_model(10000, 650, 650, 2, scan_unroll=5), p_batch,
-            windows, iters, x=px, y=py,
+            windows, iters * 4, x=px, y=py,
             criterion=_nn.TimeDistributedCriterion(
                 _nn.ClassNLLCriterion()),
             units_per_step=p_batch * seq))

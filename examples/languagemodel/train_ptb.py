@@ -33,8 +33,8 @@ def main():
     p.add_argument("--hidden", type=int, default=64)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--scan-unroll", type=int, default=1,
-                   help="unroll the time loop (exact math; ~2x on TPU "
-                        "at unroll 5 for the PTB config, see bench.py)")
+                   help="unroll the time loop (exact math; speeds up "
+                        "small-batch RNNs on TPU, see bench.py)")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
     if args.cpu:

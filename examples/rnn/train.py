@@ -31,8 +31,8 @@ def main():
     p.add_argument("--hidden-size", type=int, default=200)
     p.add_argument("--learning-rate", type=float, default=0.005)
     p.add_argument("--scan-unroll", type=int, default=1,
-                   help="unroll the time loop (exact math; ~2x on TPU "
-                        "at unroll 5 for the PTB config, see bench.py)")
+                   help="unroll the time loop (exact math; speeds up "
+                        "small-batch RNNs on TPU, see bench.py)")
     p.add_argument("--cpu", action="store_true")
     args = p.parse_args()
 
