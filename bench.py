@@ -2,11 +2,14 @@
 
 Analog of the reference's throughput harness
 ``DL/models/utils/DistriOptimizerPerf.scala:56-140`` (synthetic-input
-records/sec).  Measures a four-model menu on the local TPU chip, all as
-full training steps (fwd+bwd+SGD-momentum update): the two
+records/sec).  Measures a five-model menu on the local TPU chip, all
+as full training steps (fwd+bwd+optimizer update): the two
 BASELINE.json models — ResNet-50 and Inception-v1 (images/sec/chip) —
 plus, since round 5, VGG-16 (images/sec; the conv-heavy regression
-sentinel) and the PTB "medium" LSTM (words/sec; the scan-heavy one).
+sentinel), the PTB "medium" LSTM (words/sec; the scan-heavy one), and
+a census-dims Wide&Deep (records/sec; the sparse-embedding one —
+COO wide features + embedding bags, the BASELINE.json recommender
+config family).
 ResNet-50 failing aborts the capture (it is the headline metric); a
 failure in any secondary model records a ``<model>_error`` key and the
 rest of the capture survives.
@@ -134,7 +137,8 @@ def _toolchain():
 
 
 def _measure(model, batch: int, windows: int = 6, iters: int = 32,
-             x=None, y=None, criterion=None, units_per_step=None):
+             x=None, y=None, criterion=None, units_per_step=None,
+             compute_dtype=None):
     """Compile + run one training step.
 
     Default inputs are the ImageNet-shaped NHWC batch; recurrent/other
@@ -166,7 +170,8 @@ def _measure(model, batch: int, windows: int = 6, iters: int = 32,
         y = jnp.asarray(np.random.default_rng(1).integers(
             0, 1000, (batch,)).astype(np.int32))
 
-    base_loss = mixed_precision_loss_fn(model, criterion, jnp.bfloat16)
+    base_loss = mixed_precision_loss_fn(model, criterion,
+                                        compute_dtype or jnp.bfloat16)
     grad_fn = jax.value_and_grad(base_loss, has_aux=True)
     rng0 = jax.random.PRNGKey(42)  # dropout rng (Inception-v1 trains one)
 
@@ -230,11 +235,11 @@ def _stats(samples):
     }
 
 
-def _bottleneck(ca, ips, batch):
+def _bottleneck(ca, ips, batch, peak=PEAK_BF16_FLOPS):
     """Roofline comparison of the measured step vs the compiled
     executable's XLA-counted flop and byte floors."""
     step_ms = batch / ips * 1e3
-    t_mxu = ca["flops"] / PEAK_BF16_FLOPS * 1e3
+    t_mxu = ca["flops"] / peak * 1e3
     t_hbm = ca["bytes"] / HBM_BYTES_PER_SEC * 1e3
     return {
         "kind": "hbm" if t_hbm > t_mxu else "mxu",
@@ -447,7 +452,8 @@ def main(argv):
         print(json.dumps(out))
         return
 
-    def emit(prefix, metric_key, samples, ca, path, units_per_step):
+    def emit(prefix, metric_key, samples, ca, path, units_per_step,
+             peak=PEAK_BF16_FLOPS):
         ups, spread = _stats(samples)
         out[metric_key] = round(ups, 1)
         out[f"{prefix}_best_window"] = round(max(samples), 1)
@@ -456,18 +462,20 @@ def main(argv):
             out[f"{prefix}_cost_analysis_error"] = ca["error"]
         else:
             out[f"{prefix}_mfu"] = round(
-                ups * (ca["flops"] / units_per_step) / PEAK_BF16_FLOPS, 4)
+                ups * (ca["flops"] / units_per_step) / peak, 4)
             out[f"{prefix}_bottleneck"] = _bottleneck(
-                ca, ups, units_per_step)
+                ca, ups, units_per_step, peak)
         if path != "aot":
             out[f"{prefix}_timing_path"] = path
 
-    def emit_guarded(prefix, metric_key, units_per_step, measure):
+    def emit_guarded(prefix, metric_key, units_per_step, measure,
+                     peak=PEAK_BF16_FLOPS):
         """A secondary model's failure must not discard the primary
         metrics already measured (the r4 lost-capture failure mode)."""
         try:
             samples, ca, path = measure()
-            emit(prefix, metric_key, samples, ca, path, units_per_step)
+            emit(prefix, metric_key, samples, ca, path, units_per_step,
+                 peak)
         except Exception as e:
             out[f"{prefix}_error"] = f"{type(e).__name__}: {e}"
 
@@ -524,6 +532,55 @@ def main(argv):
             criterion=_nn.TimeDistributedCriterion(
                 _nn.ClassNLLCriterion()),
             units_per_step=p_batch * seq))
+
+    # Wide&Deep sparse-embedding workload — the remaining BASELINE.json
+    # config family (SparseTensor + embedding): COO wide features
+    # through SparseLinear/segment-sum + embedding bags + MLP, census-
+    # recipe dims at recommender batch.  f32 (lookup/bandwidth-bound;
+    # bf16 buys nothing and would perturb the segment sums), so the
+    # roofline peak is the v5e f32 matmul rate (~bf16 peak / 4 — moot
+    # in practice: this workload's MXU floor is ~0 either way).
+    wd_batch = 8192
+
+    def _wide_deep_measure():
+        from bigdl_tpu.models.recommender import WideAndDeep
+        from bigdl_tpu.nn.sparse import COOBatch
+        nnz_per = 8
+        wide_dim, fields = 100_000, [10_000, 1_000, 100, 100, 50]
+        m = WideAndDeep(wide_dim, fields, dense_dim=13, embed_dim=16,
+                        hidden=(100, 50))
+        r = np.random.default_rng(3)
+        nnz = wd_batch * nnz_per
+        coo = COOBatch(
+            jnp.asarray(np.repeat(np.arange(wd_batch, dtype=np.int32),
+                                  nnz_per)),
+            jnp.asarray(r.integers(0, wide_dim, nnz).astype(np.int32)),
+            jnp.asarray(np.ones(nnz, np.float32)),
+            (wd_batch, wide_dim))
+        deep_ids = jnp.asarray(np.stack(
+            [r.integers(0, c, wd_batch) for c in fields],
+            axis=1).astype(np.int32))
+        dense = jnp.asarray(r.normal(0, 1, (wd_batch, 13))
+                            .astype(np.float32))
+        yb = jnp.asarray(r.integers(0, 2, wd_batch).astype(np.float32))
+
+        class _SqueezeBCE:  # model emits (N, 1) logits->sigmoid
+            def __init__(self):
+                self.bce = _nn.BCECriterion()
+
+            def apply(self, out, y):
+                return self.bce.apply(out[:, 0], y)
+
+        # 2x iters: ~9 ms/step needs ~0.6 s windows for a stable
+        # median (same rationale as the PTB entry above)
+        return _measure(m, wd_batch, windows, iters * 2,
+                        x=(coo, deep_ids, dense), y=yb,
+                        criterion=_SqueezeBCE(),
+                        compute_dtype=jnp.float32)
+
+    emit_guarded("wide_deep", "wide_deep_records_per_sec_per_chip",
+                 wd_batch, _wide_deep_measure,
+                 peak=PEAK_BF16_FLOPS / 4)
 
     if not smoke:
         co = _collective_overhead()
