@@ -409,8 +409,11 @@ class MultiRNNCell(Cell):
 
     def hoist(self, params, xs):
         # only layer 0 sees the raw sequence; deeper layers consume
-        # in-loop outputs, so their projections cannot move out
-        return self.cells[0].hoist(params["0"], xs)
+        # in-loop outputs, so their projections cannot move out.
+        # getattr: layer 0 may be a duck-typed/quantized cell without
+        # the hoist API (same contract as Recurrent.apply)
+        h0 = getattr(self.cells[0], "hoist", None)
+        return h0(params["0"], xs) if h0 is not None else None
 
     def step_hoisted(self, params, zx_t, hidden):
         new_hidden = []
@@ -454,7 +457,10 @@ class Recurrent(Module):
         if self.reverse:
             xs = jnp.flip(xs, axis=0)
 
-        zx = self.cell.hoist(params, xs)
+        # duck-typed: any object with step/initial_hidden is a valid
+        # cell (quantized cells, user cells predating the hoist API)
+        hoist = getattr(self.cell, "hoist", None)
+        zx = hoist(params, xs) if hoist is not None else None
         if zx is not None:
             def body(hidden, zx_t):
                 y, new_hidden = self.cell.step_hoisted(params, zx_t,

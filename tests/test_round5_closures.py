@@ -168,6 +168,39 @@ class TestScanHoisting:
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                    atol=1e-5)
 
+    def test_duck_typed_cell_without_hoist_api(self):
+        # the Cell contract is duck-typed (quantized cells, user cells
+        # predating the hoist API provide only step/initial_hidden);
+        # Recurrent must not require the new methods
+        from bigdl_tpu.nn import recurrent as R
+
+        class MinimalCell:
+            hidden_size = 4
+
+            def initial_hidden(self, batch_size):
+                return jnp.zeros((batch_size, 4))
+
+            def step(self, params, x_t, h):
+                h2 = jnp.tanh(x_t @ params["w"] + h)
+                return h2, h2
+
+        r = R.Recurrent(MinimalCell())
+        p = {"w": jnp.ones((3, 4)) * 0.1}
+        y, _ = r.apply(p, {}, jnp.ones((2, 5, 3)))
+        assert y.shape == (2, 5, 4)
+        assert np.isfinite(np.asarray(y)).all()
+
+        # and stacked: MultiRNNCell's layer-0 hoist must duck-type too
+        class MC(MinimalCell):
+            def initial_hidden(self, batch_size):
+                return jnp.zeros((batch_size, 4))
+
+        stack = R.Recurrent(R.MultiRNNCell([MC(), R.GRU(4, 3)]))
+        g = R.GRU(4, 3)
+        gp, _ = g.init(jax.random.PRNGKey(1))
+        y2, _ = stack.apply({"0": p, "1": gp}, {}, jnp.ones((2, 5, 3)))
+        assert y2.shape == (2, 5, 3)
+
     def test_grad_flows_through_hoisted_path(self):
         from bigdl_tpu.nn import recurrent as R
         r = R.Recurrent(R.LSTM(5, 6), unroll=2)
