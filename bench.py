@@ -540,6 +540,14 @@ def main(argv):
     # bf16 buys nothing and would perturb the segment sums), so the
     # roofline peak is the v5e f32 matmul rate (~bf16 peak / 4 — moot
     # in practice: this workload's MXU floor is ~0 either way).
+    # The 0.2-0.3 hbm_floor_fraction is the wide-table gradient's
+    # random scatter (64K updates into 100K slots ≈ 3 ms measured
+    # standalone) — a lowering cost the byte model doesn't see, same
+    # class as Inception's S&S.  Alternatives measured WORSE on-chip
+    # (r5): segment_sum(indices_are_sorted=True) 4.25 vs 3.91 ms on
+    # the fwd path; sort+segsum weight-grad 4.29 vs scatter's 3.04 ms.
+    # XLA's scatter is the best known formulation; revisit per
+    # toolchain bump.
     wd_batch = 8192
 
     def _wide_deep_measure():
