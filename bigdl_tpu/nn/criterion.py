@@ -578,7 +578,9 @@ class ClassSimplexCriterion(Criterion):
     def _regsplex(n: int) -> np.ndarray:
         """n+1 vertices of a regular n-simplex, rows unit-norm, mutual dot
         products equal (reference ``regsplex``)."""
-        a = np.zeros((n + 1, n), dtype=np.float64)
+        # host-side precompute in f64 on purpose (norm recurrences lose
+        # accuracy in f32); __init__ casts the result to f32 before use
+        a = np.zeros((n + 1, n), dtype=np.float64)  # graftlint: disable=GL104
         for k in range(n):
             prior = np.linalg.norm(a[k, :k])
             a[k, k] = 1.0 if k == 0 else np.sqrt(1.0 - prior * prior)

@@ -233,6 +233,8 @@ class SparseJoinTable(Module):
             rows, cols, vals = [], [], []
             offset = 0
             n = input[0].n_rows
+            # n_rows is static pytree metadata (dense_shape), not a tracer
+            # graftlint: disable=GL102
             if any(coo.n_rows != n for coo in input):
                 raise ValueError(
                     "SparseJoinTable inputs disagree on batch size: "

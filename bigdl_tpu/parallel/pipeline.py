@@ -104,6 +104,9 @@ class GPipe(Module):
     def _template(self):
         if not hasattr(self, "_state_template"):
             _, st = self.stage.init(jax.random.PRNGKey(0))
+            # host-side lazy memo of the STATIC state-template structure
+            # (independent of traced inputs; same value on every trace)
+            # graftlint: disable=GL103
             self._state_template = st
         return self._state_template
 

@@ -1,0 +1,104 @@
+"""Regression tests for tools/byte_audit.py HLO operand parsing.
+
+Canned HLO snippets (tests/fixtures/hlo_*.txt) in the real
+``compiled.as_text()`` style — operand shapes printed inline, metadata
+attributes after the operand list — pin down two historical parsing
+bugs around tuple-shaped results:
+
+1. a consumer of a tuple-shaped value printed with its tuple type
+   (``while((s32[], f32[...]{1,0}) %tuple)``) lost every operand ref
+   after the type's internal ``)`` — split(")")[0] cut inside it, so
+   the while was charged no operand read at all;
+2. async ``*-done`` ops reference the ``*-start``'s (operand, result)
+   tuple directly (no get-tuple-element), and were charged the FULL
+   tuple instead of the aliased result element — double-counting every
+   collective's bytes.
+
+get-tuple-element-mediated consumers must always resolve the ELEMENT
+size, never the producing tuple's total.
+"""
+
+import os
+
+from tools.byte_audit import _operand_text, audit, shape_bytes
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures")
+
+F32 = 4
+BIG = 128 * 256 * F32          # f32[128,256]
+AR = 1024 * 1024 * F32         # f32[1024,1024]
+
+
+def _load(name):
+    with open(os.path.join(FIX, name)) as fh:
+        return fh.read()
+
+
+class TestShapeBytes:
+    def test_single(self):
+        assert shape_bytes("f32[128,256]{1,0}") == BIG
+        assert shape_bytes("s32[]") == 4
+        assert shape_bytes("bf16[64]") == 128
+
+    def test_tuple_sums_elements(self):
+        assert shape_bytes("(s32[], f32[128,256]{1,0})") == 4 + BIG
+
+
+class TestOperandText:
+    def test_flat(self):
+        line = "x = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b), meta={}"
+        start = line.index("add(") + 4
+        assert _operand_text(line, start) == "f32[8]{0} %a, f32[8]{0} %b"
+
+    def test_tuple_typed_operand_not_truncated(self):
+        line = ("%w = (s32[], f32[8]{0}) while((s32[], f32[8]{0}) "
+                "%tuple), condition=%c, body=%b")
+        start = line.index("while(") + 6
+        assert "%tuple" in _operand_text(line, start)
+        assert "condition" not in _operand_text(line, start)
+
+
+class TestWhileGteFixture:
+    def test_while_reads_its_tuple_operand(self):
+        by_op, _ = audit(_load("hlo_while_gte.txt"), top=10)
+        # write (4 + BIG) + read of %tuple (4 + BIG): the operand ref
+        # used to be lost to the printed tuple type's inner paren
+        assert by_op["while"] == 2 * (4 + BIG)
+
+    def test_gte_consumer_charged_element_not_tuple(self):
+        by_op, _ = audit(_load("hlo_while_gte.txt"), top=10)
+        # add = out + gte element + parameter, all f32[128,256]
+        assert by_op["add"] == 3 * BIG
+
+    def test_nested_computations_excluded(self):
+        by_op, _ = audit(_load("hlo_while_gte.txt"), top=10)
+        # %multiply.9 lives in the while body, not the entry
+        assert "multiply" not in by_op
+
+    def test_bookkeeping_ops_carry_no_traffic(self):
+        by_op, _ = audit(_load("hlo_while_gte.txt"), top=10)
+        for op in ("get-tuple-element", "tuple", "parameter"):
+            assert op not in by_op
+
+    def test_top_instructions_sorted(self):
+        _, instrs = audit(_load("hlo_while_gte.txt"), top=10)
+        sizes = [b for b, _, _, _ in instrs]
+        assert sizes == sorted(sizes, reverse=True)
+        assert sizes[0] == 3 * BIG  # the root add outranks the while
+
+
+class TestAsyncDoneFixture:
+    def test_done_charges_aliased_element_not_full_tuple(self):
+        by_op, _ = audit(_load("hlo_async_done.txt"), top=10)
+        # out + ONE aliased element — not out + 2-element tuple
+        assert by_op["all-reduce-done"] == 2 * AR
+
+    def test_start_still_counts_tuple_write(self):
+        by_op, _ = audit(_load("hlo_async_done.txt"), top=10)
+        # write (2 elements) + read of %p0
+        assert by_op["all-reduce-start"] == 3 * AR
+
+    def test_gte_off_start_resolves_element(self):
+        by_op, _ = audit(_load("hlo_async_done.txt"), top=10)
+        # add = out + done result + gte element
+        assert by_op["add"] == 3 * AR

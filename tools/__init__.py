@@ -1,0 +1,6 @@
+"""Developer tooling for the bigdl_tpu repo (not shipped with the library).
+
+- ``tools.byte_audit``  — HLO byte-traffic attribution (run as a script).
+- ``tools.graftlint``   — JAX-hazard static analysis (``python -m
+  tools.graftlint bigdl_tpu``); gates tier-1 via tests/test_graftlint.py.
+"""

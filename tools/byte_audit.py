@@ -16,9 +16,12 @@ Prints:
   reads) over the ENTRY computation of the optimized HLO — fusion
   bodies' internal values never materialize and are excluded, which is
   exactly what makes the entry-visible buffers the interesting set.
-  This parses untiled logical shapes and cannot see aliasing (async
-  wrappers re-counting their wrapped op, tuple pass-through), so
-  totals will NOT equal the cost model's; use it for RELATIVE
+  Tuple-typed operands are parsed paren-balanced, GTE consumers are
+  charged element (not tuple) sizes, and async *-done ops charge the
+  aliased result buffer only (regression-tested on canned HLO in
+  tests/test_byte_audit.py).  It still parses untiled logical shapes
+  and cannot see every aliasing (donated buffers, tuple pass-through),
+  so totals will NOT equal the cost model's; use it for RELATIVE
   attribution between two runs, with cost_analysis as ground truth;
 - the top-N largest single instructions with their opcodes/shapes.
 
@@ -74,13 +77,37 @@ _INSTR_RE = re.compile(
 _OPERAND_RE = re.compile(r"%?([\w.\-]+)")
 
 
+def _operand_text(line: str, start: int) -> str:
+    """Operand-list text from ``start`` (just past the opcode's opening
+    paren) to its MATCHING close paren.  Operands printed with a
+    tuple-typed shape — ``while((s32[], f32[...]{1,0}) %tuple)`` —
+    contain internal parens, so a naive split(")")[0] cuts inside the
+    printed type and silently drops every %ref after it."""
+    depth = 1
+    for i in range(start, len(line)):
+        c = line[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                return line[start:i]
+    return line[start:]
+
+
 def audit(hlo_text: str, top: int):
     """Aggregate bytes ACCESSED (output write + operand reads) by opcode
     over the optimized HLO's ENTRY computation only — nested
     computations (fusion bodies, reduce bodies) describe values that
     never materialize in HBM and would wildly overcount if parsed.
     This mirrors XLA cost analysis' accounting, which sums operand +
-    output sizes per top-level instruction."""
+    output sizes per top-level instruction.
+
+    Tuple handling: a get-tuple-element's consumers are charged the
+    ELEMENT size (the GTE's own declared shape), never the producing
+    tuple's total; async ``*-done`` ops, whose tuple-shaped operand
+    merely aliases the in-flight buffers, are charged their own result
+    size instead of the start op's whole (operand, result) tuple."""
     # pass 1: entry instruction shapes (for operand lookups)
     entry_lines = []
     in_entry = False
@@ -93,6 +120,7 @@ def audit(hlo_text: str, top: int):
         if in_entry:
             entry_lines.append(line)
     out_bytes = {}
+    tuple_shaped = set()
     parsed = []
     for line in entry_lines:
         m = _INSTR_RE.match(line)
@@ -100,24 +128,31 @@ def audit(hlo_text: str, top: int):
             continue
         name, shape_str, opcode = m.groups()
         out_bytes[name] = shape_bytes(shape_str)
-        parsed.append((line, name, shape_str, opcode))
+        if shape_str.lstrip().startswith("("):
+            tuple_shaped.add(name)
+        parsed.append((line, m.end(), name, shape_str, opcode))
 
     # aliasing/bookkeeping ops move no bytes themselves but must stay
     # resolvable as operands of real consumers
     no_traffic = {"get-tuple-element", "tuple", "bitcast", "parameter"}
     by_op = defaultdict(int)
     instrs = []
-    for line, name, shape_str, opcode in parsed:
+    for line, argstart, name, shape_str, opcode in parsed:
         if opcode in no_traffic:
             continue
         b = out_bytes[name]
         # operand reads: %refs in the argument list that name entry
-        # instructions.  Cut at the closing paren — attributes after it
+        # instructions.  Paren-balanced cut — attributes after the list
         # (control-predecessors={...}, calls=%fused...) also hold %refs
         # but are not reads
-        args = line.split(opcode + "(", 1)[-1].split(")")[0]
+        args = _operand_text(line, argstart)
         for ref in _OPERAND_RE.findall(args):
-            b += out_bytes.get(ref, 0)
+            rb = out_bytes.get(ref, 0)
+            if rb and ref in tuple_shaped and opcode.endswith("-done"):
+                # the done op consumes the start's aliased result
+                # buffer, not the whole (operand, result) tuple
+                rb = out_bytes[name]
+            b += rb
         if b == 0:
             continue
         # fusion kinds matter more than the generic "fusion" opcode

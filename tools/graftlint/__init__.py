@@ -1,0 +1,33 @@
+"""graftlint — JAX-hazard static analysis for the bigdl_tpu tree.
+
+The failure modes that sink a production JAX stack (silent recompiles,
+host↔device syncs in the step loop, Python control flow on tracers,
+dtype promotion leaks, nondeterministic library RNG) are invisible to
+numeric unit tests — they show up later as throughput cliffs.  This
+pass catches them at PR time; tests/test_graftlint.py wires it into
+tier-1 so it gates every PR.
+
+CLI:   python -m tools.graftlint bigdl_tpu [--json] [--changed-only]
+API:   lint_source / lint_paths / all_rules (see core.py)
+Rules: tools/graftlint/README.md is the catalog.
+"""
+
+from tools.graftlint.core import (  # noqa: F401
+    JSON_SCHEMA_VERSION,
+    LintResult,
+    REGISTRY,
+    Rule,
+    Violation,
+    all_rules,
+    filter_changed,
+    lint_paths,
+    lint_source,
+    to_human,
+    to_json,
+)
+
+__all__ = [
+    "JSON_SCHEMA_VERSION", "LintResult", "REGISTRY", "Rule", "Violation",
+    "all_rules", "filter_changed", "lint_paths", "lint_source",
+    "to_human", "to_json",
+]

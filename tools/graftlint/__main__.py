@@ -1,0 +1,68 @@
+"""CLI: ``python -m tools.graftlint bigdl_tpu``.
+
+Exit code 0 when no error-severity findings survive suppressions,
+1 otherwise, 2 on usage errors.  ``--json`` prints the machine schema
+(tests/test_graftlint.py asserts it); ``--changed-only`` scopes the run
+to git-changed files for fast local iteration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# allow running from a checkout without installing: the repo root is the
+# parent of tools/
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.graftlint import core  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX-hazard static analysis (see "
+                    "tools/graftlint/README.md for the rule catalog)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to lint "
+                         "(default: bigdl_tpu)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (schema version "
+                         f"{core.JSON_SCHEMA_VERSION})")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids/names to run "
+                         "(default: all)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs --base "
+                         "(plus untracked)")
+    ap.add_argument("--base", default="HEAD",
+                    help="git ref for --changed-only (default HEAD)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in core.all_rules():
+            print(f"{r.id}  {r.name:24s} [{r.severity}] {r.description}")
+        return 0
+
+    paths = args.paths or ["bigdl_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftlint: path not found: {p}", file=sys.stderr)
+            return 2
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    result = core.lint_paths(paths, select=select,
+                             changed_only=args.changed_only,
+                             base=args.base)
+    print(core.to_json(result) if args.json else core.to_human(result))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

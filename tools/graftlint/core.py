@@ -1,0 +1,284 @@
+"""graftlint rule framework.
+
+The analyzer is pure-AST (never imports the code it lints, never imports
+jax) so it runs in milliseconds and can gate every PR from tier-1.
+
+Pieces:
+
+- :class:`Violation` — one finding (rule id, severity, path:line:col, msg).
+- :class:`Rule` — base class; subclasses register themselves via
+  :func:`register` and implement ``check(ctx)``.
+- :class:`FileContext` — parsed file + the traced-scope model
+  (``tracing.TracedModel``) + path predicates rules use for scoping.
+- suppressions — ``# graftlint: disable=GL101`` (trailing: that line;
+  standalone comment line: the next statement line) and
+  ``# graftlint: disable-file=GL101`` (whole file).  Rule ids, rule
+  names, and ``all`` are accepted.
+- :func:`lint_source` / :func:`lint_paths` — drivers; JSON schema in
+  :func:`to_json`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import subprocess
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+from tools.graftlint import tracing
+
+SEVERITIES = ("error", "warning")
+
+JSON_SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str            # rule id, e.g. "GL101"
+    name: str            # rule slug, e.g. "host-sync"
+    severity: str        # "error" | "warning"
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message} ({self.name})")
+
+
+class Rule:
+    """One check.  Subclasses set id/name/severity/description and yield
+    Violations from ``check``."""
+
+    id: str = ""
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Violation]:
+        raise NotImplementedError
+
+    # helper so rules don't repeat the dataclass plumbing
+    def violation(self, ctx: "FileContext", node: ast.AST,
+                  message: str) -> Violation:
+        return Violation(self.id, self.name, self.severity, ctx.path,
+                         getattr(node, "lineno", 1),
+                         getattr(node, "col_offset", 0) + 1, message)
+
+
+REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and index by rule id."""
+    inst = cls()
+    assert inst.id and inst.name, cls
+    assert inst.severity in SEVERITIES, inst.severity
+    assert inst.id not in REGISTRY, f"duplicate rule id {inst.id}"
+    REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    # import for side effect: rule modules self-register
+    from tools.graftlint import rules  # noqa: F401
+    return [REGISTRY[k] for k in sorted(REGISTRY)]
+
+
+# --------------------------------------------------------------- suppressions
+
+# the directive may follow justification text in the same comment:
+# `# host-side precompute ... graftlint: disable=GL104`
+_SUPPRESS_RE = re.compile(
+    r"#.*?graftlint:\s*(disable-file|disable)\s*=\s*"
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+class Suppressions:
+    """Which rules are silenced where.
+
+    Scoping (tested in tests/test_graftlint.py):
+    - trailing comment  → suppresses that physical line only;
+    - a standalone comment line → suppresses the next statement line
+      (blank lines and further comment lines are skipped, so the
+      directive can sit above a multi-line justification block);
+    - ``disable-file`` anywhere → suppresses the whole file.
+    """
+
+    def __init__(self, source: str):
+        self.file_level: set = set()
+        self.by_line: Dict[int, set] = {}
+        lines = source.splitlines()
+        for i, line in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            kind, names = m.groups()
+            toks = {t.strip() for t in names.split(",") if t.strip()}
+            if kind == "disable-file":
+                self.file_level |= toks
+            elif line.lstrip().startswith("#"):
+                # standalone comment: applies to the next statement line
+                j = i
+                while j < len(lines) and (
+                        not lines[j].strip()
+                        or lines[j].lstrip().startswith("#")):
+                    j += 1
+                self.by_line.setdefault(j + 1, set()).update(toks)
+            else:
+                self.by_line.setdefault(i, set()).update(toks)
+
+    def is_suppressed(self, v: Violation) -> bool:
+        keys = {v.rule, v.name, "all"}
+        if self.file_level & keys:
+            return True
+        return bool(self.by_line.get(v.line, set()) & keys)
+
+
+# --------------------------------------------------------------- file context
+
+class FileContext:
+    """Everything a rule needs about one file."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.suppressions = Suppressions(source)
+        self.traced = tracing.TracedModel(self.tree, path)
+        norm = path.replace(os.sep, "/")
+        base = os.path.basename(norm)
+        self.is_test = ("/tests/" in norm or norm.startswith("tests/")
+                        or base.startswith("test_") or base == "conftest.py")
+        self.is_dataset = "/dataset/" in norm or norm.startswith("dataset/")
+        self.is_interop = "/interop/" in norm or norm.startswith("interop/")
+        self.is_library = ("bigdl_tpu" in norm and not self.is_test
+                           and not self.is_dataset)
+
+
+# -------------------------------------------------------------------- drivers
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None,
+                respect_suppressions: bool = True) -> List[Violation]:
+    """Lint one source string.  ``select`` restricts to those rule ids."""
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [Violation("GL000", "syntax-error", "error", path,
+                          e.lineno or 1, (e.offset or 0) + 1,
+                          f"file does not parse: {e.msg}")]
+    out: List[Violation] = []
+    for rule in all_rules():
+        if select and rule.id not in select and rule.name not in select:
+            continue
+        for v in rule.check(ctx):
+            if respect_suppressions and ctx.suppressions.is_suppressed(v):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def changed_files(base: str = "HEAD") -> set:
+    """Absolute paths touched vs ``base`` (staged + unstaged +
+    untracked) — the ``--changed-only`` fast path for local use.  git
+    prints repo-relative paths, so they are re-anchored at the repo
+    toplevel; lint targets given as absolute paths or from a
+    subdirectory still intersect correctly."""
+    try:
+        r = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                           capture_output=True, text=True, check=True)
+        root = r.stdout.strip()
+    except (OSError, subprocess.CalledProcessError):
+        return set()
+    out: set = set()
+    for args in (["git", "diff", "--name-only", base, "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            r = subprocess.run(args, capture_output=True, text=True,
+                               check=True, cwd=root)
+        except (OSError, subprocess.CalledProcessError):
+            continue
+        out |= {os.path.join(root, l.strip())
+                for l in r.stdout.splitlines() if l.strip()}
+    return out
+
+
+def filter_changed(files: Iterable[str], changed: Iterable[str]) -> List[str]:
+    """Intersect lint targets with a changed-path set (both sides
+    resolved to absolute paths)."""
+    norm = {os.path.abspath(c) for c in changed}
+    return [f for f in files if os.path.abspath(f) in norm]
+
+
+@dataclasses.dataclass
+class LintResult:
+    violations: List[Violation]
+    files_scanned: int
+
+    @property
+    def errors(self) -> List[Violation]:
+        return [v for v in self.violations if v.severity == "error"]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None,
+               changed_only: bool = False,
+               base: str = "HEAD") -> LintResult:
+    files = list(iter_python_files(paths))
+    if changed_only:
+        files = filter_changed(files, changed_files(base))
+    violations: List[Violation] = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            violations.extend(lint_source(fh.read(), path=f, select=select))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return LintResult(violations, len(files))
+
+
+# --------------------------------------------------------------------- output
+
+def to_json(result: LintResult) -> str:
+    counts = {"error": 0, "warning": 0}
+    for v in result.violations:
+        counts[v.severity] += 1
+    return json.dumps({
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "graftlint",
+        "files_scanned": result.files_scanned,
+        "counts": counts,
+        "violations": [dataclasses.asdict(v) for v in result.violations],
+    }, indent=2)
+
+
+def to_human(result: LintResult) -> str:
+    lines = [v.render() for v in result.violations]
+    lines.append(f"graftlint: {len(result.violations)} finding(s) "
+                 f"({len(result.errors)} error(s)) in "
+                 f"{result.files_scanned} file(s)")
+    return "\n".join(lines)

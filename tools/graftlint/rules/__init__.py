@@ -1,0 +1,10 @@
+"""Rule modules self-register with tools.graftlint.core.REGISTRY on
+import.  Importing this package loads the full default ruleset."""
+
+from tools.graftlint.rules import (  # noqa: F401
+    dtype_hygiene,
+    host_sync,
+    purity,
+    recompile,
+    tensor_branch,
+)

@@ -1,0 +1,107 @@
+"""GL104 float64-promotion and GL105 nondeterministic-rng.
+
+GL104: numpy float64 scalars/arrays are *strongly* typed — mixed into a
+``jax.numpy`` expression they promote bf16/f32 operands upward (or,
+with x64 disabled, silently truncate to f32, so the annotation lies
+either way).  Python float literals are weak-typed and fine; it is
+specifically ``np.float64(...)`` / ``dtype=np.float64`` /
+``dtype="float64"`` / ``.astype("float64")`` in library code that
+leaks.  Host-side f64 precompute that is explicitly cast before use is
+a reviewed exception (inline-suppress it with a justification).
+
+GL105: ``np.random.*`` draws in library (non-test, non-dataset) code
+break run-to-run determinism — the repo's convention is jax PRNG keys
+threaded through ``apply``/``update`` (or utils/imgops.py's salted
+SeedSequence for host-side image ops).  Seeded constructions
+(``np.random.default_rng(seed)``, ``SeedSequence(seed)``) are allowed;
+seedless ones and the global-state module functions are not.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Rule, register
+from tools.graftlint.tracing import dotted
+
+NP_NAMES = {"np", "numpy"}
+# seeded construction of these is deterministic and allowed
+SEEDED_CTORS = {"default_rng", "Generator", "SeedSequence", "PCG64",
+                "Philox", "MT19937", "SFC64", "BitGenerator"}
+
+
+@register
+class Float64Rule(Rule):
+    id = "GL104"
+    name = "float64-promotion"
+    severity = "error"
+    description = ("np.float64 / dtype='float64' in library code promotes "
+                   "under jax.numpy (or silently truncates with x64 off)")
+
+    def check(self, ctx):
+        # interop/ is the wire-format boundary: TF DataType enums, torch
+        # t7 storage classes and protobuf schemas mandate f64 there, and
+        # everything is converted on import — exempt the whole dir
+        if not ctx.is_library or ctx.is_interop:
+            return
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Attribute) and n.attr == "float64":
+                base = dotted(n.value)
+                if base in NP_NAMES or base == "jnp":
+                    yield self.violation(
+                        ctx, n, f"{base}.float64 in library code: numpy "
+                        "f64 scalars are strongly typed and promote jnp "
+                        "operands (with x64 disabled the dtype is a lie); "
+                        "use explicit f32/bf16, or suppress with a "
+                        "justification for host-side precompute")
+            elif (isinstance(n, ast.keyword) and n.arg == "dtype"
+                  and isinstance(n.value, ast.Constant)
+                  and n.value.value == "float64"):
+                yield self.violation(
+                    ctx, n.value, "dtype='float64' in library code; use "
+                    "an explicit f32/bf16 dtype")
+            elif (isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)
+                  and n.func.attr == "astype" and n.args
+                  and isinstance(n.args[0], ast.Constant)
+                  and n.args[0].value == "float64"):
+                yield self.violation(
+                    ctx, n, ".astype('float64') in library code; use an "
+                    "explicit f32/bf16 dtype")
+
+
+@register
+class NpRandomRule(Rule):
+    id = "GL105"
+    name = "nondeterministic-rng"
+    severity = "error"
+    description = ("np.random.* in library (non-test, non-dataset) code "
+                   "breaks determinism; thread jax PRNG keys or a seeded "
+                   "Generator instead")
+
+    def check(self, ctx):
+        if not ctx.is_library:
+            return
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = dotted(n.func)
+            if fn is None:
+                continue
+            parts = fn.split(".")
+            if len(parts) < 3 or parts[0] not in NP_NAMES \
+                    or parts[1] != "random":
+                continue
+            tail = parts[2]
+            if tail in SEEDED_CTORS:
+                if n.args or n.keywords:
+                    continue  # explicitly seeded → deterministic
+                yield self.violation(
+                    ctx, n, f"np.random.{tail}() without a seed is "
+                    "entropy-seeded; pass an explicit seed (see "
+                    "utils/imgops.py for the salted-SeedSequence idiom)")
+            else:
+                yield self.violation(
+                    ctx, n, f"np.random.{tail}(...) uses numpy's global "
+                    "RNG state in library code; thread a jax PRNG key "
+                    "(apply/update rng arg) or a seeded np Generator")

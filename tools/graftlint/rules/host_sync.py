@@ -1,0 +1,69 @@
+"""GL101 host-sync: device→host transfers reachable from traced code.
+
+Inside jit, ``.item()`` / ``.tolist()`` / ``float(x)`` / ``np.asarray(x)``
+on a tracer either raises (ConcretizationTypeError) or — worse, when the
+value happens to be concrete on some call paths — silently inserts a
+blocking device→host sync into the step loop.  That is the throughput
+cliff tools/byte_audit.py exists to post-mortem; catch it at PR time.
+
+Only *tainted* receivers/arguments are flagged: ``np.asarray(table)`` on
+a static config list at trace time is normal constant folding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Rule, register
+from tools.graftlint.tracing import dotted, iter_scope, last_seg
+
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+SYNC_CASTS = {"float", "int", "bool", "complex"}
+NP_SYNC_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+@register
+class HostSyncRule(Rule):
+    id = "GL101"
+    name = "host-sync"
+    severity = "error"
+    description = ("device→host sync (.item()/float()/np.asarray/"
+                   "jax.device_get) reachable from a traced function")
+
+    def check(self, ctx):
+        for fi in ctx.traced.iter_traced():
+            tainted = ctx.traced.tainted_names(fi.node)
+            for n in iter_scope(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                v = self._check_call(ctx, fi, n, tainted)
+                if v is not None:
+                    yield v
+
+    def _check_call(self, ctx, fi, n, tainted):
+        static = lambda e: ctx.traced.is_static(e, tainted)  # noqa: E731
+        if isinstance(n.func, ast.Attribute) and \
+                n.func.attr in SYNC_METHODS:
+            if not static(n.func.value):
+                return self.violation(
+                    ctx, n, f".{n.func.attr}() on a tensor inside traced "
+                    f"`{fi.name}` blocks on device→host transfer; keep "
+                    "the value on device or move the readout out of the "
+                    "step")
+        fn = dotted(n.func)
+        if fn in SYNC_CASTS and len(n.args) == 1 and not static(n.args[0]):
+            return self.violation(
+                ctx, n, f"{fn}() on a tensor inside traced `{fi.name}` "
+                "forces concretization (host sync / trace error); use "
+                "jnp casts or keep it an array")
+        if fn in NP_SYNC_FUNCS and n.args and not static(n.args[0]):
+            return self.violation(
+                ctx, n, f"{fn}() on a tensor inside traced `{fi.name}` "
+                "pulls the value to host; use jnp.asarray (stays on "
+                "device) or hoist the conversion out of the traced path")
+        if fn is not None and last_seg(n.func) == "device_get" and \
+                fn.split(".")[0] in ("jax", "api"):
+            return self.violation(
+                ctx, n, f"jax.device_get inside traced `{fi.name}` is a "
+                "blocking transfer; fetch results after the step returns")
+        return None

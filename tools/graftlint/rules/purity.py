@@ -1,0 +1,94 @@
+"""GL103 impure-forward: state mutation inside traced code.
+
+The Module contract (nn/module.py) is explicit: everything under
+``apply``/``update`` must be a pure function of its inputs — new state
+is *returned*, never written.  ``self.x = ...`` inside a traced method
+runs once at trace time and then silently never again (jit caches the
+trace), which is the classic "my running mean stopped updating" bug.
+The reference BigDL contract (``updateOutput`` writing ``this.output``)
+is exactly what this rule exists to keep out.
+
+Flags: assignments/aug-assignments/deletes through ``self``, in-place
+container mutation on ``self`` attributes (``.append``/``.update``/…),
+and ``global``/``nonlocal`` declarations.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Rule, register
+from tools.graftlint.tracing import iter_scope
+
+MUTATORS = {"append", "extend", "update", "add", "insert", "pop", "clear",
+            "remove", "setdefault", "popitem", "discard", "sort",
+            "reverse", "fill", "setflags"}
+
+
+def _rooted_at_self(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+@register
+class PurityRule(Rule):
+    id = "GL103"
+    name = "impure-forward"
+    severity = "error"
+    description = ("mutation of self attributes or module-level state "
+                   "inside a traced function (jit caches the trace; the "
+                   "write happens once, then never again)")
+
+    def check(self, ctx):
+        for fi in ctx.traced.iter_traced():
+            for n in iter_scope(fi.node):
+                v = self._check_node(ctx, fi, n)
+                if v is not None:
+                    yield v
+
+    def _check_node(self, ctx, fi, n):
+        msg = ("traced `{f}` mutates `{what}`; return the new value "
+               "instead (pure-function contract, nn/module.py)")
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (n.targets if isinstance(n, ast.Assign)
+                       else [n.target])
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                    else [t]
+                for e in elts:
+                    if _rooted_at_self(e) and not isinstance(e, ast.Name):
+                        return self.violation(
+                            ctx, n, msg.format(
+                                f=fi.name,
+                                what=ast.unparse(e) if hasattr(ast,
+                                                               "unparse")
+                                else "a self attribute"))
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                if _rooted_at_self(t) and not isinstance(t, ast.Name):
+                    return self.violation(
+                        ctx, n, msg.format(f=fi.name, what="del self.*"))
+        elif isinstance(n, ast.Call):
+            f = n.func
+            # container mutators take <=2 args; a 5-arg .update() is an
+            # optimizer's functional update, not a dict write
+            if (isinstance(f, ast.Attribute) and f.attr in MUTATORS
+                    and len(n.args) + len(n.keywords) <= 2
+                    and _rooted_at_self(f.value)
+                    and not isinstance(f.value, ast.Name)):
+                return self.violation(
+                    ctx, n, f"traced `{fi.name}` mutates a self attribute "
+                    f"in place via .{f.attr}(); build a new value and "
+                    "return it")
+        elif isinstance(n, ast.Global):
+            return self.violation(
+                ctx, n, f"traced `{fi.name}` declares `global "
+                f"{', '.join(n.names)}`; module-level state does not "
+                "survive tracing — thread it through the carry instead")
+        elif isinstance(n, ast.Nonlocal):
+            return self.violation(
+                ctx, n, f"traced `{fi.name}` declares `nonlocal "
+                f"{', '.join(n.names)}`; closure state mutated under a "
+                "trace is applied once at trace time only")
+        return None

@@ -1,0 +1,149 @@
+"""GL106 recompile-hazard: jit usage patterns that defeat the compile
+cache.
+
+Three statically-checkable shapes:
+
+1. ``jax.jit(f)(x)`` inside a function body — a fresh jit wrapper (and
+   a fresh cache) per call, so every call recompiles.  The benchmarked
+   pattern is: build the jitted callable once (module level, or once in
+   ``__init__``/setup like optim/predictor.py), then call it in the
+   loop.
+2. ``jax.jit(...)`` / ``partial(jax.jit, ...)`` created inside a
+   ``for``/``while`` body (including an ``@jax.jit`` def in a loop) —
+   same failure with a loop around it.
+3. A literal Python scalar passed positionally to a same-file jitted
+   function in a position not covered by ``static_argnums`` /
+   ``static_argnames``.  Scalar config flags baked per call either
+   retrace (when used in shapes) or silently dedupe into one trace;
+   declare them static, or pass data as arrays.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.core import Rule, register
+from tools.graftlint.tracing import iter_scope, last_seg
+
+
+def _is_jit_call(n: ast.AST) -> bool:
+    """jax.jit(...) or functools.partial(jax.jit, ...)."""
+    if not isinstance(n, ast.Call):
+        return False
+    if last_seg(n.func) == "jit":
+        return True
+    return (last_seg(n.func) == "partial"
+            and any(last_seg(a) == "jit" for a in n.args))
+
+
+def _static_decl(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for k in call.keywords:
+        if k.arg == "static_argnums":
+            for c in ast.walk(k.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                    nums.add(c.value)
+        elif k.arg == "static_argnames":
+            for c in ast.walk(k.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    names.add(c.value)
+    return nums, names
+
+
+@register
+class RecompileRule(Rule):
+    id = "GL106"
+    name = "recompile-hazard"
+    severity = "error"
+    description = ("jit wrapper built per call / per loop iteration, or a "
+                   "Python scalar literal passed to a jitted function "
+                   "without a static declaration")
+
+    def check(self, ctx):
+        yield from self._inline_and_loop(ctx)
+        yield from self._scalar_args(ctx)
+
+    # -- shapes 1 & 2 ----------------------------------------------------
+    def _inline_and_loop(self, ctx):
+        # jit-call nodes that are immediately invoked (shape 1's anchor;
+        # excluded from shape 2 so jax.jit(f)(x) in a loop reports once)
+        invoked = {id(n.func) for n in ast.walk(ctx.tree)
+                   if isinstance(n, ast.Call) and _is_jit_call(n.func)}
+        for fi in ctx.traced.funcs.values():
+            for n in iter_scope(fi.node):
+                if isinstance(n, ast.Call) and _is_jit_call(n.func):
+                    yield self.violation(
+                        ctx, n, f"jax.jit(...)(...) inside `{fi.name}` "
+                        "builds a fresh jit cache per call — every call "
+                        "recompiles; build the jitted callable once and "
+                        "reuse it")
+        seen = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for n in ast.walk(loop):
+                if n is loop or id(n) in seen:
+                    continue
+                hazard = (isinstance(n, ast.Call) and _is_jit_call(n)
+                          and id(n) not in invoked)
+                hazard = hazard or (
+                    isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and any(_is_jit_call(d) or last_seg(d) == "jit"
+                            for d in n.decorator_list))
+                if hazard:
+                    seen.add(id(n))
+                    yield self.violation(
+                        ctx, n, "jax.jit created inside a loop body — a "
+                        "fresh wrapper (and compile) per iteration; hoist "
+                        "the jit out of the loop")
+
+    # -- shape 3 ---------------------------------------------------------
+    def _scalar_args(self, ctx):
+        jitted: Dict[str, Tuple[Set[int], Set[str],
+                                Optional[List[str]]]] = {}
+        # `g = jax.jit(f, ...)` bindings: when f is a same-file def, its
+        # param names let static_argnames exonerate positional literals
+        defs = {fi.name: [a.arg for a in fi.node.args.args]
+                for fi in ctx.traced.funcs.values()}
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Assign) and _is_jit_call(n.value) \
+                    and last_seg(n.value.func) == "jit":
+                nums, names = _static_decl(n.value)
+                params = None
+                if n.value.args and isinstance(n.value.args[0], ast.Name):
+                    params = defs.get(n.value.args[0].id)
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        jitted[t.id] = (nums, names, params)
+        # `@jax.jit` / `@partial(jax.jit, static_argnums=...)` defs
+        for fi in ctx.traced.funcs.values():
+            for dec in fi.node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                    nums, names = _static_decl(dec)
+                elif _is_jit_call(dec) or last_seg(dec) == "jit":
+                    nums, names = set(), set()
+                else:
+                    continue
+                params = [a.arg for a in fi.node.args.args]
+                jitted[fi.name] = (nums, names, params)
+                break
+        for call in ast.walk(ctx.tree):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)
+                    and call.func.id in jitted):
+                continue
+            nums, names, params = jitted[call.func.id]
+            for i, a in enumerate(call.args):
+                if not (isinstance(a, ast.Constant)
+                        and isinstance(a.value, (bool, int, float))):
+                    continue
+                pname = params[i] if params and i < len(params) else None
+                if i in nums or (pname is not None and pname in names):
+                    continue
+                yield self.violation(
+                    ctx, a, f"Python scalar literal {a.value!r} passed to "
+                    f"jitted `{call.func.id}` (arg {i}) without "
+                    "static_argnums/static_argnames; declare it static "
+                    "if it is config, or pass an array if it is data")

@@ -1,0 +1,59 @@
+"""GL102 tensor-branch: Python control flow on tensor values in traced
+code.
+
+``if x.sum() > 0:`` under jit raises TracerBoolConversionError; under
+partial evaluation it silently bakes one branch into the compiled
+program.  The fix is structural: ``lax.cond`` / ``jnp.where`` for
+branches, ``lax.while_loop`` / bounded ``lax.scan`` for loops (see
+nn/control_flow.py for the framework's own wrappers).
+
+Static branches stay legal: hyper-parameter checks (``self.momentum ==
+0``), shape/rank dispatch (``x.ndim == 3``), ``rng is None`` plumbing —
+the taint model in tracing.py distinguishes them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Rule, register
+from tools.graftlint.tracing import iter_scope
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops))
+
+
+@register
+class TensorBranchRule(Rule):
+    id = "GL102"
+    name = "tensor-branch"
+    severity = "error"
+    description = ("Python if/while/assert on a tensor-valued expression "
+                   "inside a traced function (needs lax.cond / "
+                   "lax.while_loop / jnp.where)")
+
+    def check(self, ctx):
+        for fi in ctx.traced.iter_traced():
+            tainted = ctx.traced.tainted_names(fi.node)
+            for n in iter_scope(fi.node):
+                if isinstance(n, (ast.If, ast.While)):
+                    test, kind = n.test, type(n).__name__.lower()
+                    fix = ("lax.cond or jnp.where" if kind == "if"
+                           else "lax.while_loop or a bounded lax.scan")
+                elif isinstance(n, ast.Assert):
+                    test, kind, fix = n.test, "assert", \
+                        "checkify or a host-side precondition"
+                elif isinstance(n, ast.IfExp):
+                    test, kind = n.test, "conditional expression"
+                    fix = "jnp.where or lax.cond"
+                else:
+                    continue
+                if _is_none_check(test):
+                    continue
+                if ctx.traced.is_static(test, tainted):
+                    continue
+                yield self.violation(
+                    ctx, n, f"Python {kind} branches on a tensor-valued "
+                    f"expression inside traced `{fi.name}`; use {fix}")

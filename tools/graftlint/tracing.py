@@ -1,0 +1,604 @@
+"""Traced-scope model: which functions run under a jax trace, and which
+values inside them are tracers.
+
+The whole ruleset keys off this model, so it encodes the repo's own
+conventions rather than generic JAX ones:
+
+- ``nn/module.py`` contract: ``Module.apply`` (and the legacy
+  ``update_output``/``update_grad_input`` names) is the pure traced
+  forward; ``forward`` is the *eager* convenience layer and is NOT
+  traced.  A class counts as a Module if its base-name chain (resolved
+  within the file) reaches one of ``MODULE_BASES`` — this keeps
+  ``transform/vision.py``'s host-side ``FeatureTransformer.apply``
+  (numpy image ops) out of the traced set.
+- ``optim/optim_method.py`` contract: ``update(grads, params,
+  opt_state, lr, step)`` on an ``OptimMethod`` subclass is traced.
+- anything decorated with a jax transform (``jit``/``vmap``/``grad``/
+  ``checkpoint``/``shard_map``/…), directly or via
+  ``functools.partial(jax.jit, ...)``.
+- functions *passed to* a transform or a ``lax`` control-flow combinator
+  (``lax.cond``/``scan``/``while_loop``/…) at any call site.
+- closure: functions defined inside a traced function, and functions
+  reachable from a traced function through same-file calls (bare names
+  and ``self.method``) — this is what makes "host-sync reachable from a
+  jitted path" checkable.
+
+Taint: per traced function, which local names are tensor-valued.
+Parameters are tainted (minus ``self``/``cls``/``training``); static
+accessors (``.shape``/``.ndim``/``.dtype``/``.size``, ``len()``,
+``isinstance()``, ``self.*`` hyper-parameters) launder taint away, and
+host-sync escapes (``.item()``/``.tolist()``/``float()``) produce
+static values (they are GL101's problem, not GL102's).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+# jax transforms whose application makes a function traced
+TRANSFORMS = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "shard_map", "custom_vjp", "custom_jvp", "xmap",
+}
+# lax-style combinators whose callable arguments are traced
+COMBINATORS = TRANSFORMS | {
+    "cond", "while_loop", "fori_loop", "scan", "switch", "associative_scan",
+    "map",
+}
+
+# class base names whose `apply` follows the traced Module/Criterion
+# contract (textual match after in-file transitive resolution)
+MODULE_BASES = {
+    "Module", "Container", "Sequential", "Concat", "ConcatTable",
+    "ParallelTable", "Criterion", "KerasLayer",
+}
+OPTIM_BASES = {"OptimMethod"}
+
+TRACED_METHODS = {"apply", "update_output", "update_grad_input"}
+OPTIM_TRACED_METHODS = {"update"}
+
+# parameters that are never tracers under the repo's contracts
+UNTAINTED_PARAMS = {"self", "cls", "training"}
+
+# attributes that are static metadata even on a tracer
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "name", "aval",
+                "weak_type"}
+
+# calls that return host/static values regardless of argument taint
+STATIC_CALLS = {"len", "isinstance", "issubclass", "getattr", "hasattr",
+                "range", "type", "str", "repr", "format", "id",
+                # pytree STRUCTURE queries: emptiness/arity of a pytree is
+                # static even when its leaves are tracers
+                "tree_leaves", "tree_structure", "tree_flatten",
+                # mesh topology is compile-time constant (axis_index is
+                # NOT: it is a per-device traced value)
+                "axis_size", "psum_scatter_count"}
+
+# methods whose *result* is a host value even on a tracer (the sync
+# itself is GL101's finding; the result no longer taints control flow)
+SYNC_METHODS = {"item", "tolist"}
+SYNC_CASTS = {"float", "int", "bool", "complex"}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.cond' for nested Attributes, 'jit' for a Name; None
+    otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_seg(node: ast.AST) -> Optional[str]:
+    d = dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+class FuncInfo:
+    def __init__(self, node, name, class_name, parent):
+        self.node = node
+        self.name = name
+        self.class_name = class_name      # nearest enclosing class, or None
+        self.parent = parent              # enclosing FuncInfo, or None
+
+
+def iter_scope(node: ast.AST):
+    """Yield descendant nodes of a function body without descending into
+    nested function/class definitions (they are scopes of their own)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class TracedModel:
+    def __init__(self, tree: ast.Module, path: str):
+        self.tree = tree
+        self.path = path.replace("\\", "/")
+        self.funcs: Dict[int, FuncInfo] = {}
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        self._collect(tree, class_name=None, parent=None)
+        self.traced_ids: Set[int] = set()
+        self.root_ids: Set[int] = set()
+        self._mark_roots()
+        self._propagate()
+        self._taint_cache: Dict[int, Set[str]] = {}
+        # name → True when some same-file function of that name returns a
+        # tensor-valued expression (name-based: scoping ignored on purpose,
+        # it only has to be right often enough to seed the taint pass)
+        self._ret_tainted: Dict[str, bool] = {}
+        self._compute_taints()
+
+    # ------------------------------------------------------------ collection
+    def _collect(self, node, class_name, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self.class_bases[child.name] = [
+                    s for s in (last_seg(b) for b in child.bases) if s]
+                self._collect(child, class_name=child.name, parent=parent)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(child, child.name, class_name, parent)
+                self.funcs[id(child)] = fi
+                self.by_name.setdefault(child.name, []).append(fi)
+                self._collect(child, class_name=class_name, parent=fi)
+            else:
+                self._collect(child, class_name=class_name, parent=parent)
+
+    def _class_reaches(self, cls: Optional[str], targets: Set[str],
+                       seen: Optional[Set[str]] = None) -> bool:
+        """Follow in-file base-name edges; an imported (unresolvable) base
+        matches textually against ``targets``."""
+        if cls is None:
+            return False
+        seen = seen or set()
+        if cls in seen:
+            return False
+        seen.add(cls)
+        for b in self.class_bases.get(cls, []):
+            if b in targets:
+                return True
+            if b in self.class_bases and self._class_reaches(b, targets,
+                                                             seen):
+                return True
+        return False
+
+    # ----------------------------------------------------------------- roots
+    def _decorator_is_transform(self, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            # @partial(jax.jit, ...) / @jax.jit(static_argnums=...)
+            if last_seg(dec.func) == "partial":
+                return any(last_seg(a) in TRANSFORMS for a in dec.args)
+            return last_seg(dec.func) in TRANSFORMS
+        return last_seg(dec) in TRANSFORMS
+
+    def _mark_roots(self):
+        for fi in self.funcs.values():
+            node = fi.node
+            if any(self._decorator_is_transform(d)
+                   for d in node.decorator_list):
+                self._add_root(id(node))
+                continue
+            if fi.class_name is not None:
+                if (fi.name in TRACED_METHODS
+                        and self._class_reaches(fi.class_name,
+                                                MODULE_BASES)):
+                    self._add_root(id(node))
+                elif (fi.name in OPTIM_TRACED_METHODS
+                      and self._class_reaches(fi.class_name, OPTIM_BASES)):
+                    self._add_root(id(node))
+        # functions passed to transforms / combinators at any call site
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            seg = last_seg(call.func)
+            if seg not in COMBINATORS:
+                continue
+            if seg == "map":
+                # builtin map(fn, xs) is host iteration — only
+                # lax.map/jax.lax.map traces its callable
+                d = dotted(call.func)
+                if not (d and d.endswith("lax.map")):
+                    continue
+            cands = list(call.args) + [k.value for k in call.keywords]
+            for a in cands:
+                if isinstance(a, ast.Name):
+                    for fi in self.by_name.get(a.id, []):
+                        self._add_root(id(fi.node))
+                elif (isinstance(a, ast.Call)
+                      and last_seg(a.func) == "partial"):
+                    for inner in a.args:
+                        if isinstance(inner, ast.Name):
+                            for fi in self.by_name.get(inner.id, []):
+                                self._add_root(id(fi.node))
+
+    def _add_root(self, nid: int):
+        self.traced_ids.add(nid)
+        self.root_ids.add(nid)
+
+    # ----------------------------------------------------------- propagation
+    def _ancestors(self, fi: FuncInfo) -> Set[int]:
+        out = set()
+        cur: Optional[FuncInfo] = fi
+        while cur is not None:
+            out.add(id(cur))
+            cur = cur.parent
+        return out
+
+    def _resolve_call(self, fi: FuncInfo, call: ast.Call):
+        """Same-file callee candidates for a Call made inside ``fi``:
+        bare names resolve to module-level functions and closure-visible
+        nested defs; ``self.m(...)`` resolves to same-file methods."""
+        if isinstance(call.func, ast.Name):
+            anc = self._ancestors(fi)
+            cands = [c for c in self.by_name.get(call.func.id, [])
+                     if (c.parent is None and c.class_name is None)
+                     or (c.parent is not None and id(c.parent) in anc)]
+            return call.func.id, cands
+        if (isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id == "self"):
+            cands = [c for c in self.by_name.get(call.func.attr, [])
+                     if c.class_name is not None]
+            return call.func.attr, cands
+        return None, []
+
+    def _propagate(self):
+        """Fixpoint: nested defs of traced funcs are traced; same-file
+        callees of traced funcs (bare name / self.method) are traced."""
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs.values():
+                if id(fi.node) in self.traced_ids:
+                    continue
+                if fi.parent and id(fi.parent.node) in self.traced_ids:
+                    self.traced_ids.add(id(fi.node))
+                    changed = True
+            for fi in list(self.funcs.values()):
+                if id(fi.node) not in self.traced_ids:
+                    continue
+                for n in iter_scope(fi.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    callee, cands = self._resolve_call(fi, n)
+                    if callee in (None, "__init__", "init", "initialize"):
+                        continue  # eager setup paths, never traced
+                    for c in cands:
+                        if id(c.node) not in self.traced_ids:
+                            self.traced_ids.add(id(c.node))
+                            changed = True
+
+    # ------------------------------------------------------------ public API
+    def is_traced(self, node: ast.AST) -> bool:
+        return id(node) in self.traced_ids
+
+    def iter_traced(self):
+        for fi in self.funcs.values():
+            if id(fi.node) in self.traced_ids:
+                yield fi
+
+    # ---------------------------------------------------------------- taint
+    def tainted_names(self, func: ast.AST) -> Set[str]:
+        """Final local taint set for a traced function (computed by the
+        fixpoint in _compute_taints).  Untraced functions fall back to
+        the conservative all-params view."""
+        if id(func) not in self._taint_cache:
+            self._taint_cache[id(func)] = self._local_taint(
+                func, set(_all_param_names(func)) - UNTAINTED_PARAMS)
+        return self._taint_cache[id(func)]
+
+    def _local_taint(self, func: ast.AST, init: Set[str]) -> Set[str]:
+        """Propagate an initial tainted-name set through the function's
+        own assignments (two passes so forward references settle)."""
+        tainted = set(init)
+        for _ in range(2):
+            for n in iter_scope(func):
+                if isinstance(n, ast.Assign):
+                    static = self.is_static(n.value, tainted)
+                    for t in n.targets:
+                        for name in _target_names(t):
+                            (tainted.discard if static
+                             else tainted.add)(name)
+                elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                    for name in _target_names(n.target):
+                        (tainted.discard
+                         if self.is_static(n.value, tainted)
+                         else tainted.add)(name)
+                elif isinstance(n, ast.AugAssign):
+                    if not self.is_static(n.value, tainted):
+                        for name in _target_names(n.target):
+                            tainted.add(name)
+                elif isinstance(n, ast.For):
+                    self._bind_for_target(n, tainted)
+                elif isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        if item.optional_vars is not None and \
+                                not self.is_static(item.context_expr,
+                                                   tainted):
+                            for name in _target_names(item.optional_vars):
+                                tainted.add(name)
+        return tainted
+
+    def _bind_for_target(self, n: ast.For, tainted: Set[str]) -> None:
+        """Loop-target taint with container-structure awareness: dict
+        KEYS are static metadata even when the values are tracers
+        (``for name, v in input.items()`` — name is a feed name, v a
+        tensor); same for enumerate indices and zip per-position."""
+        def bind(target, static):
+            for name in _target_names(target):
+                (tainted.discard if static else tainted.add)(name)
+
+        it, tgt = n.iter, n.target
+        if isinstance(it, ast.Call):
+            two = isinstance(tgt, ast.Tuple) and len(tgt.elts) == 2
+            if isinstance(it.func, ast.Attribute):
+                recv_static = self.is_static(it.func.value, tainted)
+                if it.func.attr == "items" and two:
+                    bind(tgt.elts[0], True)
+                    bind(tgt.elts[1], recv_static)
+                    return
+                if it.func.attr == "keys":
+                    bind(tgt, True)
+                    return
+            fn = last_seg(it.func)
+            if fn == "enumerate" and two and it.args:
+                bind(tgt.elts[0], True)
+                bind(tgt.elts[1], self.is_static(it.args[0], tainted))
+                return
+            if fn == "zip" and isinstance(tgt, ast.Tuple) \
+                    and len(tgt.elts) == len(it.args):
+                for t_i, a_i in zip(tgt.elts, it.args):
+                    bind(t_i, self.is_static(a_i, tainted))
+                return
+        bind(tgt, self.is_static(it, tainted))
+
+    def _compute_taints(self):
+        """Param-level taint, interprocedurally:
+
+        - root-traced functions (jit-decorated, contract methods,
+          combinator callbacks): every param is a tracer;
+        - call-graph-propagated helpers: only params bound to a tainted
+          argument at some same-file call site — so
+          ``_conv_dims(self.format)`` style config helpers stay
+          branchable even though they are reachable from jitted paths;
+        - nested defs additionally inherit the enclosing scope's taint
+          (closure capture), minus names shadowed by their own params.
+
+        Monotone fixpoint: taints only grow, so it terminates.
+        """
+        pt: Dict[int, Set[str]] = {}
+        for fi in self.iter_traced():
+            nid = id(fi.node)
+            if nid in self.root_ids:
+                pt[nid] = (set(_all_param_names(fi.node))
+                           - UNTAINTED_PARAMS
+                           - _static_config_params(fi.node))
+            else:
+                pt[nid] = set()
+        local: Dict[int, Set[str]] = {}
+        for _ in range(12):  # files converge in 2-3 rounds
+            changed = False
+            local = {}
+            # funcs dict preserves collection order: parents first
+            for fi in self.iter_traced():
+                inherited: Set[str] = set()
+                if fi.parent is not None and id(fi.parent.node) in local:
+                    inherited = (local[id(fi.parent.node)]
+                                 - set(_all_param_names(fi.node)))
+                local[id(fi.node)] = self._local_taint(
+                    fi.node, pt[id(fi.node)] | inherited)
+                lt = local[id(fi.node)]
+                for n in iter_scope(fi.node):
+                    if isinstance(n, ast.Return) and n.value is not None \
+                            and not self.is_static(n.value, lt) \
+                            and not self._ret_tainted.get(fi.name):
+                        self._ret_tainted[fi.name] = True
+                        changed = True
+            for fi in self.iter_traced():
+                lt = local[id(fi.node)]
+                for call in iter_scope(fi.node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    _, cands = self._resolve_call(fi, call)
+                    for c in cands:
+                        cid = id(c.node)
+                        if cid not in pt or cid in self.root_ids:
+                            continue
+                        if self._bind_call_taint(call, c.node, lt,
+                                                 pt[cid]):
+                            changed = True
+            if not changed:
+                break
+        self._taint_cache = dict(local)
+
+    def _bind_call_taint(self, call: ast.Call, callee: ast.AST,
+                         caller_taint: Set[str],
+                         callee_pt: Set[str]) -> bool:
+        """Bind tainted caller arguments to callee param names.  Returns
+        True when callee_pt grew."""
+        a = callee.args
+        pos = [x.arg for x in list(getattr(a, "posonlyargs", [])) + a.args]
+        if pos and pos[0] in ("self", "cls"):
+            pos = pos[1:]
+        allp = set(_all_param_names(callee))
+        # a scalar type annotation is a declaration that the param is
+        # host-side config — trust it over the call-site binding
+        declared_static = _annotated_static_params(callee)
+        before = len(callee_pt)
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                if not self.is_static(arg.value, caller_taint):
+                    callee_pt.update(pos[i:])
+                    if a.vararg is not None:
+                        callee_pt.add(a.vararg.arg)
+                break
+            if self.is_static(arg, caller_taint):
+                continue
+            if i < len(pos):
+                callee_pt.add(pos[i])
+            elif a.vararg is not None:
+                callee_pt.add(a.vararg.arg)
+        for kw in call.keywords:
+            if self.is_static(kw.value, caller_taint):
+                continue
+            if kw.arg is None or kw.arg not in allp:
+                if a.kwarg is not None:
+                    callee_pt.add(a.kwarg.arg)
+            else:
+                callee_pt.add(kw.arg)
+        callee_pt -= UNTAINTED_PARAMS
+        callee_pt -= declared_static
+        return len(callee_pt) > before
+
+    def is_static(self, node: ast.AST, tainted: Set[str]) -> bool:
+        """True when the expression is host-computable (hyper-parameters,
+        shapes, constants) — i.e. safe to branch on at trace time."""
+        if node is None or isinstance(node, (ast.Constant, ast.JoinedStr,
+                                             ast.Lambda)):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id not in tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return True
+            return self.is_static(node.value, tainted)
+        if isinstance(node, ast.Subscript):
+            return (self.is_static(node.value, tainted)
+                    and self.is_static(node.slice, tainted))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return all(self.is_static(e, tainted) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return all(self.is_static(e, tainted)
+                       for e in (node.keys + node.values) if e is not None)
+        if isinstance(node, ast.Starred):
+            return self.is_static(node.value, tainted)
+        if isinstance(node, ast.Slice):
+            return all(self.is_static(e, tainted)
+                       for e in (node.lower, node.upper, node.step))
+        if isinstance(node, ast.UnaryOp):
+            return self.is_static(node.operand, tainted)
+        if isinstance(node, ast.BinOp):
+            return (self.is_static(node.left, tainted)
+                    and self.is_static(node.right, tainted))
+        if isinstance(node, ast.BoolOp):
+            return all(self.is_static(v, tainted) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # identity checks (`rng is None`) are resolved at trace
+            # time regardless of what the operands hold
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return True
+            # `"key" in params` / `nm in memo`: membership of a static
+            # key in a dict/pytree is a static structure probe, even
+            # when the container's leaves are tracers.  (Limitation:
+            # `x in arr` elementwise membership on an *array* with a
+            # static x is not caught — rare, and jnp.isin is the idiom.)
+            if (all(isinstance(op, (ast.In, ast.NotIn))
+                    for op in node.ops)
+                    and self.is_static(node.left, tainted)):
+                return True
+            return (self.is_static(node.left, tainted)
+                    and all(self.is_static(c, tainted)
+                            for c in node.comparators))
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                             ast.DictComp)):
+            inner = set(tainted)
+            for gen in node.generators:
+                names = set(_target_names(gen.target))
+                if self.is_static(gen.iter, inner):
+                    inner -= names
+                else:
+                    inner |= names
+                if not all(self.is_static(i, inner) for i in gen.ifs):
+                    return False
+            if isinstance(node, ast.DictComp):
+                return (self.is_static(node.key, inner)
+                        and self.is_static(node.value, inner))
+            return self.is_static(node.elt, inner)
+        if isinstance(node, ast.IfExp):
+            return all(self.is_static(e, tainted)
+                       for e in (node.test, node.body, node.orelse))
+        if isinstance(node, ast.Call):
+            # host-sync escapes: result is a python scalar (GL101 flags
+            # the sync itself)
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in SYNC_METHODS):
+                return True
+            fn = last_seg(node.func)
+            if fn in SYNC_CASTS or fn in STATIC_CALLS:
+                return True
+            # contract methods return tensors by definition; same-file
+            # functions known to return tensor-valued expressions too
+            if fn in TRACED_METHODS or fn in OPTIM_TRACED_METHODS \
+                    or self._ret_tainted.get(fn):
+                return False
+            return (self.is_static(node.func, tainted)
+                    and all(self.is_static(a, tainted) for a in node.args)
+                    and all(self.is_static(k.value, tainted)
+                            for k in node.keywords))
+        return False  # unknown expression kinds: assume tensor-valued
+
+
+def _annotated_static_params(func: ast.AST) -> Set[str]:
+    """Params annotated with a Python scalar type (``causal: bool``,
+    ``target: str``) — a declaration that the value is host-side config;
+    traced values are arrays and annotated as such."""
+    out: Set[str] = set()
+    a = func.args
+    for arg in (list(getattr(a, "posonlyargs", [])) + a.args
+                + a.kwonlyargs):
+        ann = arg.annotation
+        if isinstance(ann, ast.Name) and ann.id in ("str", "bool", "int",
+                                                    "float"):
+            out.add(arg.arg)
+    return out
+
+
+def _static_config_params(func: ast.AST) -> Set[str]:
+    """Params of a *root* traced function that are static config rather
+    than tracers: scalar-annotated (see _annotated_static_params — under
+    shard_map/partial these are bound statically), or carrying a Python
+    scalar default (``eps=1e-6``)."""
+    out = _annotated_static_params(func)
+    a = func.args
+    pos = list(getattr(a, "posonlyargs", [])) + a.args
+    for arg, d in zip(reversed(pos), reversed(a.defaults)):
+        if isinstance(d, ast.Constant) and isinstance(
+                d.value, (bool, int, float, str)):
+            out.add(arg.arg)
+    for arg, d in zip(a.kwonlyargs, a.kw_defaults):
+        if isinstance(d, ast.Constant) and isinstance(
+                d.value, (bool, int, float, str)):
+            out.add(arg.arg)
+    return out
+
+
+def _all_param_names(func: ast.AST) -> List[str]:
+    a = func.args
+    out = [x.arg for x in (list(getattr(a, "posonlyargs", [])) + a.args
+                           + a.kwonlyargs)]
+    for x in (a.vararg, a.kwarg):
+        if x is not None:
+            out.append(x.arg)
+    return out
+
+
+def _target_names(t: ast.AST):
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_names(e)
+    elif isinstance(t, ast.Starred):
+        yield from _target_names(t.value)
+    # attribute/subscript stores don't bind local names
