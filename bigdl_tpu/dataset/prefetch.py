@@ -12,6 +12,15 @@ prefetch queue so batch ``i+1`` is assembled while the jit'd step runs
 batch ``i``.  Composes as a normal Transformer:
 
     dataset >> MTSampleToMiniBatch(128, per_sample_fn, workers=8)
+
+The pipeline has TWO prefetch stages since the fused-dispatch rework:
+
+1. host assembly (this transformer): samples → MiniBatches on worker
+   threads, buffered in a bounded queue;
+2. device staging (:class:`DeviceBlockStager`): consecutive MiniBatches
+   → one host-stacked K-step block → asynchronously ``device_put`` so
+   block ``i+1`` is already landing in HBM (sharded, for the SPMD
+   path) while the jit'd K-step scan crunches block ``i``.
 """
 
 from __future__ import annotations
@@ -27,6 +36,105 @@ import numpy as np
 from bigdl_tpu.dataset.sample import Sample, MiniBatch
 from bigdl_tpu.dataset.transformer import Transformer
 from bigdl_tpu.utils.imgops import sample_key
+
+
+def _leaf_meta(leaf):
+    return (tuple(np.shape(leaf)), getattr(leaf, "dtype", None))
+
+
+def batch_signature(batch: MiniBatch):
+    """Structural identity of a batch — pytree structure + per-leaf
+    shape/dtype.  Blocks only stack batches with identical signatures
+    (a ragged remainder batch, or a bucket change in a padded text/COO
+    pipeline, ends the block instead of crashing ``np.stack``)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (batch.input, batch.target))
+    return treedef, tuple(_leaf_meta(l) for l in leaves)
+
+
+class DeviceBlockStager:
+    """Device-prefetch stage: pulls MiniBatches from the host pipeline,
+    stacks up to ``k`` of them along a new leading step axis, and hands
+    the stack to ``place_block`` (``jnp.asarray`` tree locally; a
+    ``P(None, "data")``-sharded global-array build under SPMD).
+
+    ``jax.device_put``-family transfers are asynchronous, so a driver
+    that stages block ``i+1`` right after dispatching block ``i`` gets
+    the double-buffer for free: the host→HBM DMA of ``i+1`` overlaps
+    the device compute of ``i``, and the jit dispatch never waits on a
+    transfer.  The stager itself never looks at driver state — the
+    driver passes a step cap (from the trigger probe) and a records
+    budget (to the epoch boundary) per block, which is what keeps
+    epoch/trigger semantics exact under fusion.
+    """
+
+    def __init__(self, batch_iter, place_block):
+        self._it = batch_iter
+        self._place = place_block
+        self._held = None  # batch pulled but deferred to the next block
+
+    def reset(self, batch_iter) -> None:
+        """Point at a fresh iterator (epoch rollover: the driver
+        shuffles and re-opens the dataset, exactly like the unfused
+        loop did).  Never called with lookahead in flight — blocks are
+        budgeted to stop AT the epoch boundary, so the stager holds no
+        stale pre-shuffle batches."""
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            close()
+        self._it = batch_iter
+        self._held = None
+
+    def take(self, k: int, records_budget: int):
+        """Stage the next block: up to ``k`` consecutive same-signature
+        batches whose cumulative size stays within ``records_budget``
+        (the batch that reaches the budget — the epoch-boundary step —
+        is included; the NEXT pull would belong to the next epoch).
+
+        Returns ``(dev_xs, dev_ys, sizes)`` where dev arrays carry a
+        leading ``len(sizes)`` step axis and ``dev_ys`` is None for
+        unlabelled batches.  Raises StopIteration if the host pipeline
+        is exhausted with nothing staged (finite iterator misuse — the
+        training contract is an infinite shuffled stream)."""
+        batches = []
+        sig = None
+        total = 0
+        while len(batches) < max(1, int(k)) and total < records_budget:
+            if self._held is not None:
+                b, self._held = self._held, None
+            else:
+                try:
+                    b = next(self._it)
+                except StopIteration:
+                    break
+            if not isinstance(b, MiniBatch):
+                raise TypeError(
+                    "training dataset must yield MiniBatch (attach "
+                    "SampleToMiniBatch / MTSampleToMiniBatch)")
+            b_sig = batch_signature(b)
+            if sig is None:
+                sig = b_sig
+            elif b_sig != sig:
+                self._held = b  # ragged/bucket change: next block's head
+                break
+            batches.append(b)
+            total += b.size()
+        if not batches:
+            raise StopIteration(
+                "training data iterator exhausted mid-epoch — train=True "
+                "iterators must be infinite (see AbstractDataSet.data)")
+        import jax
+        tmap = jax.tree_util.tree_map
+        xs = tmap(lambda *ls: np.stack([np.asarray(l) for l in ls]),
+                  *[b.input for b in batches])
+        if batches[0].target is None:
+            ys = None
+        else:
+            ys = tmap(lambda *ls: np.stack([np.asarray(l) for l in ls]),
+                      *[b.target for b in batches])
+        dev_xs, dev_ys = self._place(xs, ys)
+        return dev_xs, dev_ys, [b.size() for b in batches]
 
 
 def _stack(samples) -> MiniBatch:
@@ -122,7 +230,21 @@ class MTSampleToMiniBatch(Transformer):
             except BaseException as e:  # surface worker errors to consumer
                 put_or_stop(e)
             finally:
-                pool.shutdown(wait=False)
+                # cancel queued per-sample work so idle workers exit now
+                # instead of grinding through a chunk nobody will read
+                pool.shutdown(wait=False, cancel_futures=True)
+                # propagate shutdown upstream: in a chained pipeline the
+                # source is itself a generator (possibly another MT
+                # assembler) whose own cleanup must run NOW, on the one
+                # thread that consumed it — not whenever GC finds it
+                # (that is the thread-leak window the early-exit
+                # regression tests pin down)
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:  # source cleanup must not mask
+                        pass           # the original error/_END delivery
                 # _END must be DELIVERED, not best-effort: a put_nowait
                 # here can hit a momentarily-full queue while the consumer
                 # is alive and leave it blocked on get() forever.  The
@@ -142,8 +264,18 @@ class MTSampleToMiniBatch(Transformer):
                 yield item
         finally:
             stop.set()
-            # drain so the producer can observe `stop` and exit
+            # drain so the producer can observe `stop` and exit, then
+            # reap it DETERMINISTICALLY: close()/throw() mid-epoch must
+            # not leave the thread (or its queued batches) behind.  The
+            # join is bounded — a producer stuck in a pathological
+            # user transform stays a daemon and cannot hang teardown.
             while True:
+                try:
+                    out_q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
+            while True:  # items put during the join window
                 try:
                     out_q.get_nowait()
                 except queue.Empty:

@@ -29,6 +29,11 @@ def _default_retry_times() -> int:
     return get_config().failure_retry_times
 
 
+def _default_steps_per_dispatch() -> int:
+    from bigdl_tpu.utils.config import get_config
+    return get_config().steps_per_dispatch
+
+
 @dataclass
 class _EngineState:
     initialized: bool = False
@@ -38,6 +43,12 @@ class _EngineState:
     # loop); default flows from the unified typed config
     # (utils/config.Config.failure_retry_times, env BIGDL_TPU_*)
     failure_retry_times: int = field(default_factory=_default_retry_times)
+    # K-step dispatch fusion for the training driver loop (config
+    # steps_per_dispatch / env BIGDL_TPU_STEPS_PER_DISPATCH); optimizers
+    # resolve it here unless overridden per-run via
+    # Optimizer.set_steps_per_dispatch
+    steps_per_dispatch: int = field(
+        default_factory=_default_steps_per_dispatch)
 
 
 class Engine:
@@ -103,3 +114,14 @@ class Engine:
     @classmethod
     def seed(cls) -> int:
         return cls._state.seed
+
+    @classmethod
+    def steps_per_dispatch(cls) -> int:
+        """How many train steps the driver fuses into one jit dispatch."""
+        return max(1, int(cls._state.steps_per_dispatch))
+
+    @classmethod
+    def set_steps_per_dispatch(cls, k: int) -> None:
+        if int(k) < 1:
+            raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+        cls._state.steps_per_dispatch = int(k)

@@ -7,10 +7,14 @@ gradient put, (B) per-node aggregation of its 1/N gradient slice, optimizer
 update of its 1/N weight slice, weight re-publish.  That is literally a
 reduce-scatter + all-gather with a sharded optimizer update (ZeRO-1).
 
-TPU redesign: ONE jit'd SPMD train step over a ``jax.sharding.Mesh``.
+TPU redesign: ONE jit'd SPMD step-block over a ``jax.sharding.Mesh``,
+driven by the shared fused/pipelined loop in ``Optimizer._train_driver``
+(K-step ``lax.scan`` fusion + double-buffered device prefetch — the
+analog of BigDL 2.0 hiding the per-iteration Spark job dispatch cost).
 
-- The global batch is sharded over the ``data`` mesh axis (the analog of
-  one data partition per executor).
+- The global batch rides the ``data`` mesh axis (the analog of one data
+  partition per executor); a staged K-step block is sharded
+  ``P(None, "data")`` — step axis replicated, batch axis sharded.
 - Params are replicated; XLA inserts the gradient AllReduce over ICI when
   it sees sharded-batch grads meet replicated params — replacing
   ``putGradients``/``aggregateGradientPartition`` (+ its FP16 wire format:
@@ -25,7 +29,7 @@ TPU redesign: ONE jit'd SPMD train step over a ``jax.sharding.Mesh``.
 - Straggler gradient-dropping (``DistriOptimizer.scala:398-425``) is
   intentionally absent: SPMD collectives are lock-step; XLA's synchronous
   model replaces it (documented divergence, SURVEY.md §7 stage 4).
-- Failure retry-from-checkpoint (``:981-1061``) is in the driver loop.
+- Failure retry-from-checkpoint (``:981-1061``) wraps the driver loop.
 
 Multi-host: each process feeds its local shard of the global batch via
 ``jax.make_array_from_process_local_data``; ``jax.distributed.initialize``
@@ -35,8 +39,6 @@ is the analog of Spark executor registration.
 from __future__ import annotations
 
 import logging
-import time
-from functools import partial
 from typing import Optional
 
 import jax
@@ -78,6 +80,10 @@ class DistriOptimizer(Optimizer):
         self.parameter_sharding = parameter_sharding
         self.param_specs = param_specs
         self.failure_retry_times = Engine._state.failure_retry_times
+        self._param_sh = None
+        self._ostate_sh = None
+        self._block_sh = None  # P(None, "data"): step axis × batch axis
+        self._n_dev = 1
 
     # -------------------------------------------------------- shardings
     def _shardings(self, params, ostate):
@@ -105,14 +111,60 @@ class DistriOptimizer(Optimizer):
                     ostate_sh[key] = tmap(lambda _: repl, sub)
         else:
             ostate_sh = tmap(lambda _: repl, ostate)
-        data = NamedSharding(mesh, P("data"))
-        return repl, data, param_sh, ostate_sh
+        return repl, param_sh, ostate_sh
 
     def _make_global(self, arr: np.ndarray, sharding: NamedSharding):
         """Per-host local shard → global device array (multi-host safe)."""
         if jax.process_count() == 1:
             return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(sharding, arr)
+
+    # ----------------------------------------------- train-driver hooks
+    def _place_train_block(self, xs, ys):
+        """Staged (K, local_batch, ...) host trees → global arrays with
+        the step axis replicated and the batch axis sharded over `data`
+        (the per-microbatch analog of one data partition per executor).
+        The ``device_put`` underneath is asynchronous — the driver
+        stages block i+1 while block i computes, so this is where the
+        double-buffered host→HBM transfer actually happens."""
+        place = lambda a: self._make_global(np.asarray(a), self._block_sh)
+        xs = tmap(place, xs)
+        ys = None if ys is None else tmap(place, ys)
+        return xs, ys
+
+    def _records_scale(self) -> int:
+        # batch.size() is the PER-HOST local batch; under multi-host the
+        # assembled global array is process_count× larger, and epoch
+        # accounting compares against the GLOBAL dataset.size()
+        return jax.process_count()
+
+    def _constrain_step_outputs(self, params, ostate):
+        # pin output layouts so the pattern stays reduce-scatter+gather
+        # (ZeRO-1) / TP-sharded across every step of the scanned block
+        params = jax.lax.with_sharding_constraint(params, self._param_sh)
+        ostate = jax.lax.with_sharding_constraint(ostate, self._ostate_sh)
+        return params, ostate
+
+    def _log_train_iteration(self, lr: float) -> None:
+        s = self.state
+        logger.info(
+            "epoch %d iter %d loss %.4f lr %.5g throughput %.1f rec/s "
+            "(%.1f rec/s/dev)",
+            s["epoch"], s["neval"], s["loss"], lr, s["throughput"],
+            s["throughput"] / self._n_dev)
+
+    def _log_parameter_histograms(self, params) -> None:
+        # trigger-gated per-parameter histograms (reference
+        # DistriOptimizer.scala:541-573 "Parameters" summary)
+        ptrig = getattr(self.train_summary, "trigger_for",
+                        lambda _n: None)("Parameters")
+        if ptrig is not None and ptrig(self.state):
+            flat = jax.tree_util.tree_flatten_with_path(params)[0]
+            for path, leaf in flat:
+                tag = "Parameters/" + "/".join(
+                    str(getattr(k, "key", k)) for k in path)
+                self.train_summary.add_histogram(
+                    tag, np.asarray(leaf), self.state["neval"])
 
     # ------------------------------------------- multi-host-safe val/ckpt
     # Eval placement hooks: batches go through the same ``_make_global``
@@ -206,11 +258,11 @@ class DistriOptimizer(Optimizer):
 
     def _optimize_impl(self):
         mesh = self.mesh
-        n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        self._n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
         rng = jax.random.PRNGKey(self.seed)
         rng, init_rng = jax.random.split(rng)
         if self.model._params is not None:
-            # copy: train_step donates its inputs; without this the
+            # copy: the block fn donates its inputs; without this the
             # caller-owned model arrays would be deleted by donation
             # (device_put below is a no-op for already-placed arrays)
             params = jax.tree_util.tree_map(jnp.array, self.model._params)
@@ -222,7 +274,9 @@ class DistriOptimizer(Optimizer):
             self._resume_opt_state = None
         else:
             ostate = self.optim_method.init_state(params)
-        repl, data_sh, param_sh, ostate_sh = self._shardings(params, ostate)
+        repl, param_sh, ostate_sh = self._shardings(params, ostate)
+        self._param_sh, self._ostate_sh = param_sh, ostate_sh
+        self._block_sh = NamedSharding(mesh, P(None, "data"))
 
         # place initial values
         params = tmap(lambda x, s: jax.device_put(x, s), params, param_sh)
@@ -230,96 +284,14 @@ class DistriOptimizer(Optimizer):
         mstate = tmap(lambda x: jax.device_put(x, repl), mstate)
 
         grad_fn = self._loss_and_grad_fn()
-        grad_clip = self.grad_clip
-        optim = self.optim_method
-
-        mstate_sh = tmap(lambda _: repl, mstate)
-
-        # donated: rebound to outputs every iteration → in-place HBM update
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, mstate, ostate, x, y, lr, step, rng):
-            """Global-semantics SPMD step: x/y are sharded over `data`;
-            XLA inserts the grad AllReduce (params replicated) or
-            reduce-scatter/all-gather (ostate sharded) over ICI."""
-            (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
-            if grad_clip is not None:
-                grads = grad_clip(grads)
-            params, ostate = optim.update(grads, params, ostate, lr, step)
-            # pin output layouts so the pattern stays reduce-scatter+gather
-            params = jax.lax.with_sharding_constraint(params, param_sh)
-            ostate = jax.lax.with_sharding_constraint(ostate, ostate_sh)
-            return params, new_mstate, ostate, loss
-
-        data_iter = self.dataset.data(train=True)
-        epoch_size = self.dataset.size()
-        state = self.state
-        self._fast_forward(data_iter, state)
         logger.info(
             "DistriOptimizer: %d samples/epoch, mesh=%s, zero1=%s",
-            epoch_size, dict(zip(mesh.axis_names, mesh.devices.shape)),
+            self.dataset.size(),
+            dict(zip(mesh.axis_names, mesh.devices.shape)),
             self.parameter_sharding)
 
-        while not self.end_when(state):
-            t0 = time.perf_counter()
-            with self.metrics.time("data"):
-                batch = next(data_iter)
-                # inputs may be pytrees (multi-input models)
-                x = tmap(lambda a: self._make_global(np.asarray(a), data_sh),
-                         batch.input)
-                y = tmap(lambda a: self._make_global(np.asarray(a), data_sh),
-                         batch.target)
-            # batch.size() is the PER-HOST local batch; under multi-host the
-            # assembled global array is process_count× larger, and epoch
-            # accounting compares against the GLOBAL dataset.size()
-            global_batch = batch.size() * jax.process_count()
-            lr = self.optim_method.current_lr(state["neval"], state["epoch"])
-            rng, step_rng = jax.random.split(rng)
-            with self.metrics.time("computing"):
-                params, mstate, ostate, loss = train_step(
-                    params, mstate, ostate, x, y, lr, state["neval"],
-                    step_rng)
-                loss = float(loss)
-            dt = time.perf_counter() - t0
-
-            state["neval"] += 1
-            state["records_processed_this_epoch"] += global_batch
-            state["loss"] = loss
-            state["throughput"] = global_batch / dt
-            logger.info(
-                "epoch %d iter %d loss %.4f lr %.5g throughput %.1f rec/s "
-                "(%.1f rec/s/dev)",
-                state["epoch"], state["neval"], loss, lr,
-                state["throughput"], state["throughput"] / n_dev)
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar("LearningRate", lr,
-                                              state["neval"])
-                self.train_summary.add_scalar("Throughput",
-                                              state["throughput"],
-                                              state["neval"])
-                # trigger-gated per-parameter histograms (reference
-                # DistriOptimizer.scala:541-573 "Parameters" summary)
-                ptrig = getattr(self.train_summary, "trigger_for",
-                                lambda _n: None)("Parameters")
-                if ptrig is not None and ptrig(state):
-                    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-                    for path, leaf in flat:
-                        tag = "Parameters/" + "/".join(
-                            str(getattr(k, "key", k)) for k in path)
-                        self.train_summary.add_histogram(
-                            tag, np.asarray(leaf), state["neval"])
-
-            state["epoch_finished"] = \
-                state["records_processed_this_epoch"] >= epoch_size
-            if state["epoch_finished"]:
-                state["epoch"] += 1
-                state["records_processed_this_epoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
-
-            self._run_validation(params, mstate)
-            self._maybe_checkpoint(params, mstate, ostate)
-            state["epoch_finished"] = False
+        params, mstate, ostate = self._train_driver(params, mstate, ostate,
+                                                    grad_fn, rng)
 
         self.model._params = params
         self.model._state = mstate

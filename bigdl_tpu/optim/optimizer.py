@@ -5,11 +5,37 @@ Reference: ``DL/optim/Optimizer.scala:47`` builder API (``setValidation``,
 ``setEndWhen:389``, gradient clipping ``:423+``) whose factory dispatches
 ``LocalOptimizer`` (single JVM) vs ``DistriOptimizer`` (Spark).
 
-Here: :class:`Optimizer` holds the builder surface + the shared driver loop
-machinery; :class:`LocalOptimizer` jit-compiles the train step for the
-local device (1 TPU chip); ``DistriOptimizer`` (bigdl_tpu.optim.
-distri_optimizer) shard_maps it over the mesh.  The factory
-``Optimizer.create`` mirrors the reference's dispatch.
+Here: :class:`Optimizer` holds the builder surface + the ONE driver loop
+both trainers share; :class:`LocalOptimizer` jit-compiles the train step
+for the local device (1 TPU chip); ``DistriOptimizer`` (bigdl_tpu.optim.
+distri_optimizer) shard_maps it over the mesh via the placement /
+sharding-constraint hooks.  The factory ``Optimizer.create`` mirrors the
+reference's dispatch.
+
+Driver-loop design (the analog of hiding the reference's per-iteration
+2-Spark-job orchestration cost, ``DistriOptimizer.scala``'s step):
+
+- **K-step dispatch fusion**: ``steps_per_dispatch = K`` stacks K
+  microbatches and runs the (loss, grad, update) step under ``lax.scan``
+  inside ONE jit with donated params/mstate/ostate — one host dispatch
+  per K iterations instead of per iteration.  The per-step loss vector
+  comes back so triggers/summaries still observe every iteration.
+- **Exact trigger/epoch semantics**: blocks are planned with
+  ``trigger.probe_fire_step`` so a validation/checkpoint/end iteration
+  is always a block's LAST step, and epoch boundaries flush partial
+  blocks (the stager's records budget) — iteration numbers, shuffle
+  cadence, and mid-epoch resume behave identically for every K.
+- **Pipelined host work**: the next block is staged (host-stacked and
+  asynchronously ``device_put``) right after a dispatch, so the
+  host→HBM transfer of block i+1 overlaps the compute of block i; the
+  blocking loss fetch runs ONE BLOCK BEHIND the dispatch, so the device
+  queue is never drained by a ``float(loss)`` — not even at K=1.
+
+Documented divergence: triggers keyed on ``loss``/``score`` (min_loss,
+max_score) are probed with their last known values, so under pipelining
+they stop/validate at the correct *iteration number* but the device may
+already have run up to one extra block (the final params then include
+those extra steps).  Iteration- and epoch-count triggers are exact.
 
 Gradient clipping maps the reference's ``ConstantClippingProcessor`` /
 ``L2NormClippingProcessor`` (``parameters/ParameterOperations.scala:71,89``)
@@ -31,11 +57,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.prefetch import DeviceBlockStager
 from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.engine import Engine
 from bigdl_tpu.nn.criterion import Criterion
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
-from bigdl_tpu.optim.trigger import Trigger, max_epoch
+from bigdl_tpu.optim.trigger import Trigger, max_epoch, probe_fire_step
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.utils.checkpoint import save_checkpoint
 from bigdl_tpu.utils.metrics import Metrics
@@ -69,8 +97,31 @@ def clip_by_global_norm(grads, max_norm: float):
     return tmap(lambda g: g * scale, grads)
 
 
+class _Staged:
+    """A planned, device-placed K'-step block awaiting dispatch."""
+
+    __slots__ = ("xs", "ys", "sizes", "lrs", "lrs_dev", "steps_dev",
+                 "rngs_dev", "sync")
+
+    def __init__(self, xs, ys, sizes, lrs, lrs_dev, steps_dev, rngs_dev,
+                 sync):
+        self.xs, self.ys, self.sizes = xs, ys, sizes
+        self.lrs, self.lrs_dev = lrs, lrs_dev
+        self.steps_dev, self.rngs_dev = steps_dev, rngs_dev
+        self.sync = sync  # a trigger/epoch/end boundary ends this block
+
+
+class _InFlight:
+    """A dispatched block whose per-step losses are still on device."""
+
+    __slots__ = ("losses", "sizes", "lrs", "t0")
+
+    def __init__(self, losses, sizes, lrs, t0):
+        self.losses, self.sizes, self.lrs, self.t0 = losses, sizes, lrs, t0
+
+
 class Optimizer:
-    """Builder + driver-loop base."""
+    """Builder + the shared fused/pipelined driver loop."""
 
     def __init__(self, model: Module, dataset: AbstractDataSet,
                  criterion: Criterion, batch_size: Optional[int] = None):
@@ -92,6 +143,8 @@ class Optimizer:
         self.validation_summary = None
         self.metrics = Metrics()
         self.seed = 1
+        # K-step dispatch fusion; None = Engine/config default
+        self.steps_per_dispatch: Optional[int] = None
 
         # driver state (reference: the state Table inside OptimMethod —
         # epoch/neval survive checkpoint/resume)
@@ -100,6 +153,9 @@ class Optimizer:
         self._eval_fwd = None  # cached jit'd eval forward
         self._resume_opt_state = None  # optimizer state restored on retry
         self.compute_dtype = None  # None = full f32; jnp.bfloat16 for MXU
+        self._dispatch_count = 0  # jit dispatches issued (observability)
+        self._stager: Optional[DeviceBlockStager] = None
+        self._epoch_size = 0
 
     # ------------------------------------------------------------- builder
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -162,6 +218,17 @@ class Optimizer:
         """Mixed precision: fwd/bwd in ``dtype`` (bf16 for the MXU), master
         params + optimizer update stay f32.  See utils/precision.py."""
         self.compute_dtype = dtype
+        return self
+
+    def set_steps_per_dispatch(self, k: int) -> "Optimizer":
+        """Fuse ``k`` consecutive train steps into one jit dispatch
+        (``lax.scan`` over stacked microbatches).  Loss trajectory and
+        trigger cadence are K-invariant; raise it when the per-step
+        compute is small enough that host dispatch shows up in the step
+        time (BENCH: PTB-LSTM, Wide&Deep)."""
+        if int(k) < 1:
+            raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+        self.steps_per_dispatch = int(k)
         return self
 
     def set_state(self, state: dict) -> "Optimizer":
@@ -253,6 +320,229 @@ class Optimizer:
             sched.record(first.result)
         return results
 
+    # ------------------------------------------------- train-loop hooks
+    # DistriOptimizer overrides these to shard the work over the mesh;
+    # the driver loop itself lives only here.
+    def _place_train_block(self, xs, ys):
+        """Host-stacked (K, batch, ...) trees → device arrays."""
+        xs = tmap(jnp.asarray, xs)
+        ys = None if ys is None else tmap(jnp.asarray, ys)
+        return xs, ys
+
+    def _records_scale(self) -> int:
+        """Host-local batch rows → global records (process_count under
+        multi-host SPMD)."""
+        return 1
+
+    def _constrain_step_outputs(self, params, ostate):
+        """Inside the jit'd step, after the optimizer update — the SPMD
+        subclass pins output shardings here."""
+        return params, ostate
+
+    def _log_train_iteration(self, lr: float) -> None:
+        # reference per-iteration log line (DistriOptimizer.scala:388-394)
+        s = self.state
+        logger.info(
+            "epoch %d iter %d loss %.4f lr %.5g throughput %.1f rec/s",
+            s["epoch"], s["neval"], s["loss"], lr, s["throughput"])
+
+    def _log_parameter_histograms(self, params) -> None:
+        """Trigger-gated per-parameter summaries (SPMD subclass)."""
+
+    # --------------------------------------------------- fused train step
+    def _build_block_fn(self, grad_fn, k: int):
+        """One jit'd dispatch covering ``k`` consecutive train steps.
+
+        ``k == 1`` stays a straight-line step (identical HLO to the
+        classic per-iteration dispatch, minus a leading-axis squeeze);
+        ``k > 1`` runs the step under ``lax.scan`` so XLA sees one
+        program — no per-iteration dispatch, and donated
+        params/mstate/ostate update in place across the whole block.
+        Inputs: ``xs``/``ys`` carry a leading ``k`` step axis (sharded
+        over `data` on axis 1 in the SPMD path); ``lrs``/``steps``/
+        ``rngs`` are per-step vectors so host-side LR schedules never
+        retrace.  Returns the per-step loss vector — every iteration
+        stays observable to triggers and summaries."""
+        grad_clip = self.grad_clip
+        optim = self.optim_method
+        constrain = self._constrain_step_outputs
+
+        def one_step(params, mstate, ostate, x, y, lr, step, rng):
+            (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
+            if grad_clip is not None:
+                grads = grad_clip(grads)
+            params, ostate = optim.update(grads, params, ostate, lr, step)
+            params, ostate = constrain(params, ostate)
+            return params, new_mstate, ostate, loss
+
+        if k == 1:
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def block_fn(params, mstate, ostate, xs, ys, lrs, steps, rngs):
+                x = tmap(lambda a: a[0], xs)
+                y = None if ys is None else tmap(lambda a: a[0], ys)
+                params, mstate, ostate, loss = one_step(
+                    params, mstate, ostate, x, y, lrs[0], steps[0], rngs[0])
+                return params, mstate, ostate, loss[None]
+            return block_fn
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def block_fn(params, mstate, ostate, xs, ys, lrs, steps, rngs):
+            def body(carry, inp):
+                params, mstate, ostate = carry
+                x, y, lr, step, rng = inp
+                params, mstate, ostate, loss = one_step(
+                    params, mstate, ostate, x, y, lr, step, rng)
+                return (params, mstate, ostate), loss
+
+            (params, mstate, ostate), losses = jax.lax.scan(
+                body, (params, mstate, ostate),
+                (xs, ys, lrs, steps, rngs))
+            return params, mstate, ostate, losses
+        return block_fn
+
+    # ------------------------------------------------------ driver loop
+    def _train_driver(self, params, mstate, ostate, grad_fn, rng):
+        """The shared training loop (see module docstring for the
+        fusion/pipelining design).  Returns the final (params, mstate,
+        ostate) bindings."""
+        state = self.state
+        k_max = self.steps_per_dispatch or Engine.steps_per_dispatch()
+        k_max = max(1, int(k_max))
+        scale = self._records_scale()
+        epoch_size = self._epoch_size = self.dataset.size()
+        data_iter = self.dataset.data(train=True)
+        self._fast_forward(data_iter, state)
+        stager = DeviceBlockStager(data_iter, self._place_train_block)
+        self._stager = stager
+        # the Parameters-histogram summary trigger is probed too: its
+        # firing iteration must end a sync block so the histogram sees
+        # exactly that iteration's params, not the end-of-block binding
+        param_trig = getattr(self.train_summary, "trigger_for",
+                             lambda _n: None)("Parameters") \
+            if self.train_summary is not None else None
+        triggers = (self.validation_trigger, self.checkpoint_trigger,
+                    self.end_when, param_trig)
+        block_fns: dict = {}
+        self._dispatch_count = 0
+        bsz_hint = 0
+        # planning counters: where the driver state WILL be once every
+        # dispatched block has been replayed (at most one block ahead)
+        p_neval = state["neval"]
+        p_epoch = state["epoch"]
+        p_records = state["records_processed_this_epoch"]
+
+        def stage_next():
+            """Plan (trigger probe + epoch budget) and stage one block.
+            Runs right after a dispatch, so the host stacking and the
+            asynchronous host→device transfer overlap the in-flight
+            block's compute — the double buffer."""
+            nonlocal rng, bsz_hint
+            probe_state = dict(state)
+            probe_state.update(
+                neval=p_neval, epoch=p_epoch,
+                records_processed_this_epoch=p_records)
+            fire = probe_fire_step(probe_state, k_max, bsz_hint * scale,
+                                   epoch_size, triggers)
+            k_plan = fire if fire is not None else k_max
+            budget = max(1, -(-(epoch_size - p_records) // scale))
+            with self.metrics.time("data"):
+                xs, ys, sizes = stager.take(k_plan, budget)
+            k = len(sizes)
+            bsz_hint = sizes[0]
+            # per-step host scalars, one current_lr call per iteration in
+            # order (schedules and the retry tests rely on that cadence)
+            lrs = [float(self.optim_method.current_lr(p_neval + j, p_epoch))
+                   for j in range(k)]
+            keys = []
+            for _ in range(k):
+                rng, step_rng = jax.random.split(rng)
+                keys.append(step_rng)
+            ends_epoch = p_records + sum(sizes) * scale >= epoch_size
+            sync = ends_epoch or fire == k
+            return _Staged(xs, ys, sizes, lrs,
+                           jnp.asarray(np.asarray(lrs, np.float32)),
+                           jnp.asarray(np.arange(p_neval, p_neval + k,
+                                                 dtype=np.int32)),
+                           jnp.stack(keys), sync)
+
+        pending: Optional[_InFlight] = None
+        staged: Optional[_Staged] = None
+        while True:
+            if staged is None:
+                if pending is None and self.end_when(state):
+                    break
+                staged = stage_next()
+            k = len(staged.sizes)
+            fn = block_fns.get(k)
+            if fn is None:
+                fn = block_fns[k] = self._build_block_fn(grad_fn, k)
+            t0 = time.perf_counter()
+            params, mstate, ostate, losses = fn(
+                params, mstate, ostate, staged.xs, staged.ys,
+                staged.lrs_dev, staged.steps_dev, staged.rngs_dev)
+            self._dispatch_count += 1
+            block = _InFlight(losses, staged.sizes, staged.lrs, t0)
+            p_neval += k
+            p_records += sum(staged.sizes) * scale
+            if p_records >= epoch_size:
+                p_epoch += 1
+                p_records = 0
+            sync = staged.sync
+            # double-buffer: next block's H2D lands while this one runs
+            # (a sync block ends at a boundary the replay must handle —
+            # shuffle/validation/stop — before any further staging)
+            staged = stage_next() if not sync else None
+            if pending is not None:
+                ended = self._replay_block(pending, params, mstate, ostate)
+                pending = None
+                if ended:
+                    break
+            if sync:
+                if self._replay_block(block, params, mstate, ostate):
+                    break
+            else:
+                pending = block
+        return params, mstate, ostate
+
+    def _replay_block(self, block: _InFlight, params, mstate, ostate):
+        """Fetch a dispatched block's per-step losses (the driver's only
+        device→host sync — one block behind the dispatch on the steady
+        path) and replay its iterations through the driver state:
+        per-iteration logging/summaries, epoch rollover (shuffle + fresh
+        iterator, exactly as the unfused loop did), validation and
+        checkpoint triggers at their exact iteration numbers, and the
+        end_when check.  Returns True when training should stop."""
+        with self.metrics.time("computing"):
+            losses = np.asarray(jax.device_get(block.losses))
+        per_step = (time.perf_counter() - block.t0) / len(block.sizes)
+        state = self.state
+        scale = self._records_scale()
+        for j, n_local in enumerate(block.sizes):
+            n = n_local * scale
+            state["neval"] += 1
+            state["records_processed_this_epoch"] += n
+            state["loss"] = float(losses[j])
+            state["throughput"] = n / per_step
+            lr = block.lrs[j]
+            self._log_train_iteration(lr)
+            if self.train_summary is not None:
+                self.train_summary.add_train_step(
+                    state["neval"], state["loss"], lr, state["throughput"])
+                self._log_parameter_histograms(params)
+            state["epoch_finished"] = \
+                state["records_processed_this_epoch"] >= self._epoch_size
+            if state["epoch_finished"]:
+                state["epoch"] += 1
+                state["records_processed_this_epoch"] = 0
+                self.dataset.shuffle()
+                self._stager.reset(self.dataset.data(train=True))
+            self._run_validation(params, mstate)
+            self._maybe_checkpoint(params, mstate, ostate)
+            state["epoch_finished"] = False
+            if self.end_when(state):
+                return True
+        return False
+
     # placement hooks — DistriOptimizer overrides these for sharded /
     # multi-host evaluation; the loop itself lives only here
     def _place_eval_input(self, x):
@@ -301,15 +591,16 @@ class LocalOptimizer(Optimizer):
     """Single-host training loop (reference ``LocalOptimizer.scala:45``).
 
     The reference clones the model per core and sums gradients across
-    thread replicas; under XLA one jit'd step uses the whole chip, so the
-    loop is: next batch → jit'd (loss, grad, update) → triggers.
+    thread replicas; under XLA one jit'd step-block uses the whole chip,
+    so the loop is: stage next block → dispatch fused (loss, grad,
+    update) block → replay triggers (see Optimizer._train_driver).
     """
 
     def optimize(self) -> Module:
         rng = jax.random.PRNGKey(self.seed)
         rng, init_rng = jax.random.split(rng)
         if self.model._params is not None:
-            # copy: train_step donates its inputs, and these arrays are
+            # copy: the block fn donates its inputs, and these arrays are
             # owned by the caller's model — donation would delete them,
             # corrupting the model on a failed/interrupted run
             params = jax.tree_util.tree_map(jnp.array, self.model._params)
@@ -323,69 +614,10 @@ class LocalOptimizer(Optimizer):
             ostate = self.optim_method.init_state(params)
 
         grad_fn = self._loss_and_grad_fn()
-        grad_clip = self.grad_clip
-        optim = self.optim_method
-
-        # donate params/mstate/ostate: they are rebound to the outputs each
-        # iteration, so XLA can update in place instead of copying ~2x the
-        # model + optimizer state through HBM every step
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def train_step(params, mstate, ostate, x, y, lr, step, rng):
-            (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
-            if grad_clip is not None:
-                grads = grad_clip(grads)
-            params, ostate = optim.update(grads, params, ostate, lr, step)
-            return params, new_mstate, ostate, loss
-
-        data_iter = self.dataset.data(train=True)
-        epoch_size = self.dataset.size()
-        state = self.state
-        self._fast_forward(data_iter, state)
         logger.info("LocalOptimizer: %d samples/epoch, device=%s",
-                    epoch_size, jax.devices()[0])
-
-        while not self.end_when(state):
-            t0 = time.perf_counter()
-            with self.metrics.time("data"):
-                batch = next(data_iter)
-            n_records = batch.size()
-            lr = self.optim_method.current_lr(state["neval"], state["epoch"])
-            rng, step_rng = jax.random.split(rng)
-            with self.metrics.time("computing"):
-                params, mstate, ostate, loss = train_step(
-                    params, mstate, ostate,
-                    device_tree(batch.input), device_tree(batch.target),
-                    lr, state["neval"], step_rng)
-                loss = float(loss)
-            dt = time.perf_counter() - t0
-
-            state["neval"] += 1
-            state["records_processed_this_epoch"] += n_records
-            state["loss"] = loss
-            state["throughput"] = n_records / dt
-            # reference per-iteration log line (DistriOptimizer.scala:388-394)
-            logger.info(
-                "epoch %d iter %d loss %.4f lr %.5g throughput %.1f rec/s",
-                state["epoch"], state["neval"], loss, lr, state["throughput"])
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss, state["neval"])
-                self.train_summary.add_scalar("LearningRate", lr,
-                                              state["neval"])
-                self.train_summary.add_scalar("Throughput",
-                                              state["throughput"],
-                                              state["neval"])
-
-            state["epoch_finished"] = \
-                state["records_processed_this_epoch"] >= epoch_size
-            if state["epoch_finished"]:
-                state["epoch"] += 1
-                state["records_processed_this_epoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
-
-            self._run_validation(params, mstate)
-            self._maybe_checkpoint(params, mstate, ostate)
-            state["epoch_finished"] = False
+                    self.dataset.size(), jax.devices()[0])
+        params, mstate, ostate = self._train_driver(params, mstate, ostate,
+                                                    grad_fn, rng)
 
         # write trained weights back into the user's model object
         # (reference: final getModel copy, DistriOptimizer.scala:1063)

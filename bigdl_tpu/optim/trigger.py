@@ -9,9 +9,19 @@ State keys (mirroring the reference's state Table): ``epoch`` (0-based,
 current), ``neval`` (iteration counter, 1-based after first step),
 ``loss``, ``score``, and ``epoch_finished`` (set by the loop at epoch
 boundaries so everyEpoch fires once per rollover).
+
+The fused K-step driver (optimizer.py) additionally *probes* triggers
+ahead of time via :func:`probe_fire_step` so a dispatch block never runs
+past an iteration where a trigger needs host-side action.  Probed states
+carry ``probe: True`` so stateful trigger-like objects (test spies,
+metric recorders) can tell a simulation from the real per-iteration
+replay; ``loss``/``score`` hold their last REAL values during a probe
+(a block is planned before its losses exist).
 """
 
 from __future__ import annotations
+
+from typing import Iterable, Optional
 
 
 class Trigger:
@@ -111,3 +121,41 @@ def max_score(s: float) -> Trigger:
 
 def min_loss(l: float) -> Trigger:
     return _MinLoss(l)
+
+
+def probe_fire_step(state: dict, k_max: int, records_per_step: int,
+                    epoch_size: int,
+                    triggers: Iterable[Trigger]) -> Optional[int]:
+    """First step offset j in ``1..k_max`` at which any trigger would
+    fire, simulating the driver-state advance from ``state`` — or None
+    when a full ``k_max``-step block is trigger-free.
+
+    This is how the fused loop keeps trigger semantics EXACT for
+    iteration/epoch-count triggers at K>1: a block is capped so that a
+    firing iteration is always the block's LAST step, and the host
+    replay (validation/checkpoint/stop) happens with the params of
+    exactly that iteration.  Loss/score-keyed triggers are probed with
+    their last known values (the block's losses don't exist yet); they
+    still fire at the right iteration during the replay, but the probe
+    can't pre-sync on them — see the "stepping & input pipeline"
+    section of the README for the documented divergence.
+
+    ``records_per_step`` is the GLOBAL batch size (0 = unknown: epoch
+    rollover is then left to the stager's records budget, which stops a
+    block at the boundary from the actual batch sizes)."""
+    triggers = [t for t in triggers if t is not None]
+    neval = state.get("neval", 0)
+    epoch = state.get("epoch", 0)
+    records = state.get("records_processed_this_epoch", 0)
+    for j in range(1, int(k_max) + 1):
+        sim = dict(state)
+        sim["probe"] = True
+        sim["neval"] = neval + j
+        rec = records + j * records_per_step
+        finishes_epoch = records_per_step > 0 and rec >= epoch_size
+        sim["records_processed_this_epoch"] = 0 if finishes_epoch else rec
+        sim["epoch"] = epoch + 1 if finishes_epoch else epoch
+        sim["epoch_finished"] = finishes_epoch
+        if finishes_epoch or any(t(sim) for t in triggers):
+            return j
+    return None

@@ -27,6 +27,12 @@ class Config:
     # data pipeline
     prefetch_batches: int = 2          # MTSampleToMiniBatch default queue
     loader_workers: int = 4            # per-host preprocessing threads
+    # driver loop: K consecutive train steps fused into ONE jit dispatch
+    # (lax.scan over stacked microbatches).  1 = classic step-per-dispatch;
+    # raise for dispatch-bound workloads (small-step LSTMs, sparse recs).
+    # Blocks are auto-flushed at epoch/trigger boundaries, so semantics
+    # are K-invariant; see README "stepping & input pipeline".
+    steps_per_dispatch: int = 1
     # numerics
     compute_dtype: str = "float32"     # "bfloat16" flips matmul precision
     matmul_precision: str = "default"  # jax "default"|"high"|"highest"

@@ -191,6 +191,20 @@ class TrainSummary(Summary):
         super().__init__(log_dir, app_name, "train")
         self._triggers = {}
 
+    def add_train_step(self, step: int, loss: float, lr: float,
+                       throughput: float) -> "TrainSummary":
+        """One training iteration's standard scalar triple.  The fused
+        K-step driver replays a whole dispatch block through here — one
+        call per iteration, each with its own loss from the block's
+        per-step loss vector — so the event file is indistinguishable
+        from an unfused run's; a single flush covers the three records
+        (the replay writes K·3 records back-to-back)."""
+        self.writer.add_scalar("Loss", loss, step)
+        self.writer.add_scalar("LearningRate", lr, step)
+        self.writer.add_scalar("Throughput", throughput, step)
+        self.writer.flush()
+        return self
+
     def set_summary_trigger(self, name: str, trigger) -> "TrainSummary":
         """Gate optional summaries (e.g. Parameters histograms) by trigger
         (reference ``DistriOptimizer.scala:541-573``)."""

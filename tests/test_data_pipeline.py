@@ -313,6 +313,56 @@ class TestReviewFixes:
         assert threading.active_count() <= before + 1, \
             "producer thread leaked after early consumer exit"
 
+    def test_chained_close_propagates_to_inner_assembler(self):
+        """Early consumer exit on a CHAINED pipeline: closing the outer
+        generator must shut the inner assembler's producer thread down
+        deterministically (the outer producer closes its source in its
+        finally), not leave it to GC."""
+        import threading
+
+        def sample_stream():
+            i = 0
+            while True:  # infinite: only shutdown propagation ends it
+                yield Sample(np.full(3, i, np.float32), np.int32(0))
+                i += 1
+
+        before = threading.active_count()
+        inner = MTSampleToMiniBatch(4, None, workers=2, prefetch=2)
+        rebatch = MTSampleToMiniBatch(2, None, workers=2, prefetch=2)
+
+        def batch_to_samples(batches):
+            for b in batches:
+                for i in range(b.size()):
+                    yield Sample(b.input[i], b.target[i])
+
+        outer = rebatch(batch_to_samples(inner(sample_stream())))
+        next(outer)
+        outer.close()  # must cascade: outer producer → inner generator
+        deadline = time.time() + 5.0
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, \
+            "chained early exit leaked a producer thread"
+
+    def test_throw_mid_epoch_cleans_up_threads_and_queue(self):
+        """Exception injected at the consumption point (generator.throw
+        — what a crashing training loop does to its data iterator) must
+        neither deadlock the bounded queue nor leak the producer."""
+        import threading
+        before = threading.active_count()
+        samples = [Sample(np.zeros(4, np.float32), np.int32(0))
+                   for _ in range(4096)]
+        mt = MTSampleToMiniBatch(4, None, workers=2, prefetch=1)
+        it = mt(iter(samples))
+        next(it)
+        with pytest.raises(RuntimeError, match="step exploded"):
+            it.throw(RuntimeError("step exploded"))
+        deadline = time.time() + 5.0
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, \
+            "producer thread leaked after consumer exception"
+
     def test_shared_lighting_constants(self):
         from bigdl_tpu.dataset import image
         from bigdl_tpu.transform import vision as V

@@ -399,12 +399,103 @@ class TestRecompile:
 
 
 # ===========================================================================
+# GL107 driver-loop host sync
+# ===========================================================================
+OPTIM = "bigdl_tpu/optim/fake.py"
+
+
+class TestDriverLoopHostSync:
+    def test_positive_float_on_step_output_in_while_loop(self):
+        vs = lint("""
+            import jax
+            from functools import partial
+            def optimize(params, ostate, batches, done):
+                @partial(jax.jit, donate_argnums=(0, 1))
+                def train_step(params, ostate, x):
+                    return params, ostate, (params * x).sum()
+                while not done():
+                    x = next(batches)
+                    params, ostate, loss = train_step(params, ostate, x)
+                    loss = float(loss)
+                return params
+            """, path=OPTIM)
+        assert [v.rule for v in vs] == ["GL107"]
+        assert "driver loop" in vs[0].message
+
+    def test_positive_asarray_item_and_jit_assign_binding(self):
+        vs = lint("""
+            import jax
+            import numpy as np
+            def _step(p, x):
+                return p, x.sum()
+            def optimize(p, batches):
+                step = jax.jit(_step, donate_argnums=(0,))
+                for x in batches:
+                    p, loss = step(p, x)
+                    a = np.asarray(loss)
+                    b = loss.item()
+                return p
+            """, path=OPTIM)
+        assert [v.rule for v in vs] == ["GL107"] * 2
+
+    def test_negative_deferred_one_step_behind_fetch(self):
+        # the fix GL107 prescribes: sync the PREVIOUS iteration's value
+        # before the dispatch rebinds it — sync-above-producer is clean
+        assert rule_ids("""
+            import jax
+            from functools import partial
+            def optimize(params, ostate, batches, done):
+                @partial(jax.jit, donate_argnums=(0, 1))
+                def train_step(params, ostate, x):
+                    return params, ostate, (params * x).sum()
+                prev = None
+                while not done():
+                    if prev is not None:
+                        lv = float(prev)
+                    params, ostate, prev = train_step(
+                        params, ostate, next(batches))
+                return params
+            """, path=OPTIM) == []
+
+    def test_negative_non_donating_jit_is_an_eval_loop(self):
+        # predict/evaluate loops legitimately fetch each batch's output;
+        # the donating signature is what marks a TRAINING step
+        assert rule_ids("""
+            import jax
+            import numpy as np
+            def evaluate(params, batches):
+                fwd = jax.jit(lambda p, x: (p * x).sum())
+                outs = []
+                for x in batches:
+                    out = fwd(params, x)
+                    outs.append(np.asarray(out))
+                return outs
+            """, path=OPTIM) == []
+
+    def test_negative_outside_optim_path(self):
+        src = """
+            import jax
+            from functools import partial
+            def drive(p, xs, done):
+                @partial(jax.jit, donate_argnums=(0,))
+                def step(p, x):
+                    return p, x.sum()
+                while not done():
+                    p, loss = step(p, next(xs))
+                    float(loss)
+                return p
+            """
+        assert "GL107" not in rule_ids(src, path="bigdl_tpu/utils/fake.py")
+        assert "GL107" not in rule_ids(src, path="tests/test_fake.py")
+
+
+# ===========================================================================
 # rule catalog invariants
 # ===========================================================================
 class TestCatalog:
     def test_every_rule_registered_with_metadata(self):
         rules = all_rules()
-        assert len(rules) >= 6
+        assert len(rules) >= 7
         ids = [r.id for r in rules]
         assert ids == sorted(ids)
         for r in rules:

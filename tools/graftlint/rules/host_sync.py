@@ -1,13 +1,26 @@
-"""GL101 host-sync: device→host transfers reachable from traced code.
+"""GL101 host-sync + GL107 driver-loop host sync.
 
-Inside jit, ``.item()`` / ``.tolist()`` / ``float(x)`` / ``np.asarray(x)``
-on a tracer either raises (ConcretizationTypeError) or — worse, when the
-value happens to be concrete on some call paths — silently inserts a
-blocking device→host sync into the step loop.  That is the throughput
-cliff tools/byte_audit.py exists to post-mortem; catch it at PR time.
+GL101: inside jit, ``.item()`` / ``.tolist()`` / ``float(x)`` /
+``np.asarray(x)`` on a tracer either raises (ConcretizationTypeError)
+or — worse, when the value happens to be concrete on some call paths —
+silently inserts a blocking device→host sync into the step loop.  That
+is the throughput cliff tools/byte_audit.py exists to post-mortem;
+catch it at PR time.
 
 Only *tainted* receivers/arguments are flagged: ``np.asarray(table)`` on
 a static config list at trace time is normal constant folding.
+
+GL107: the *driver-side* sibling.  A training driver loop (``optim/``)
+that dispatches a donated jit step and then immediately blocks on one of
+its outputs (``float(loss)``, ``.item()``, ``np.asarray``) drains the
+device pipeline once per iteration — legal Python, no tracer involved,
+but it serializes host dispatch against device compute (the exact stall
+class the fused K-step loop + one-block-behind loss fetch removes).
+The heuristic: inside a ``while``/``for`` body, a host sync on a name
+produced EARLIER IN THE SAME ITERATION by a call to a donating jit
+callable.  The deferred pattern — sync the *previous* iteration's value
+before the dispatch rebinds it — reads in source order as sync-above-
+producer and is deliberately clean.
 """
 
 from __future__ import annotations
@@ -66,4 +79,119 @@ class HostSyncRule(Rule):
             return self.violation(
                 ctx, n, f"jax.device_get inside traced `{fi.name}` is a "
                 "blocking transfer; fetch results after the step returns")
+        return None
+
+
+def _is_jit_call(n: ast.AST) -> bool:
+    """``jax.jit(...)`` or ``functools.partial(jax.jit, ...)``."""
+    if not isinstance(n, ast.Call):
+        return False
+    if last_seg(n.func) == "jit":
+        return True
+    return (last_seg(n.func) == "partial"
+            and any(last_seg(a) == "jit" for a in n.args))
+
+
+def _donates(call: ast.Call) -> bool:
+    return any(k.arg in ("donate_argnums", "donate_argnames")
+               for k in call.keywords)
+
+
+def _target_name_nodes(t: ast.AST):
+    if isinstance(t, ast.Name):
+        yield t
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_name_nodes(e)
+    elif isinstance(t, ast.Starred):
+        yield from _target_name_nodes(t.value)
+
+
+@register
+class DriverLoopHostSyncRule(Rule):
+    id = "GL107"
+    name = "driver-loop-host-sync"
+    severity = "error"
+    description = ("blocking float()/.item()/np.asarray on a donated-jit "
+                   "step output inside a while/for training-driver loop "
+                   "(optim/) — drains the dispatch pipeline every "
+                   "iteration; fetch one step behind instead")
+
+    SYNC_FUNCS = {"float", "int"}
+    SYNC_NP = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+    def check(self, ctx):
+        norm = ctx.path.replace("\\", "/")
+        if ctx.is_test or ("/optim/" not in norm
+                           and not norm.startswith("optim/")):
+            return
+        for fi in ctx.traced.funcs.values():
+            if ctx.traced.is_traced(fi.node):
+                continue  # traced code is GL101's jurisdiction
+            steps = self._donating_step_names(fi.node)
+            if not steps:
+                continue
+            for loop in iter_scope(fi.node):
+                if isinstance(loop, (ast.While, ast.For)):
+                    yield from self._check_loop(ctx, fi, loop, steps)
+
+    def _donating_step_names(self, func: ast.AST) -> set:
+        """Names that invoke a DONATING jit in this function's scope —
+        the training-step signature (eval forwards don't donate, so
+        predict/evaluate fetch loops stay out of scope).  Shapes:
+        ``@partial(jax.jit, donate_argnums=...)`` on a nested def, and
+        ``step = jax.jit(f, donate_argnums=...)`` bindings."""
+        out = set()
+        for n in ast.walk(func):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in n.decorator_list:
+                    if _is_jit_call(dec) and _donates(dec):
+                        out.add(n.name)
+            elif isinstance(n, ast.Assign) and _is_jit_call(n.value) \
+                    and _donates(n.value):
+                for t in n.targets:
+                    for nm in _target_name_nodes(t):
+                        out.add(nm.id)
+        return out
+
+    def _check_loop(self, ctx, fi, loop, steps):
+        # outputs of a donating-step call, keyed by the line the call
+        # rebinds them on — a sync is only a pipeline stall when it
+        # happens AFTER the producing dispatch in the same iteration
+        produced: dict = {}
+        for n in iter_scope(loop):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and isinstance(n.value.func, ast.Name) \
+                    and n.value.func.id in steps:
+                for t in n.targets:
+                    for nm in _target_name_nodes(t):
+                        produced[nm.id] = min(n.lineno,
+                                              produced.get(nm.id, n.lineno))
+        if not produced:
+            return
+        for n in iter_scope(loop):
+            if not isinstance(n, ast.Call):
+                continue
+            name = self._synced_name(n)
+            if name in produced and n.lineno > produced[name]:
+                yield self.violation(
+                    ctx, n, f"blocking host fetch of `{name}` right after "
+                    f"its producing dispatch in `{fi.name}`'s driver loop "
+                    "— the device queue drains every iteration; fetch one "
+                    "step/block behind (see Optimizer._replay_block) or "
+                    "move the readout out of the loop")
+
+    def _synced_name(self, call: ast.Call):
+        """The Name a sync call blocks on, else None."""
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in SYNC_METHODS \
+                and isinstance(call.func.value, ast.Name):
+            return call.func.value.id
+        fn = dotted(call.func)
+        if call.args and isinstance(call.args[0], ast.Name):
+            if fn in self.SYNC_FUNCS or fn in self.SYNC_NP:
+                return call.args[0].id
+            if fn is not None and last_seg(call.func) == "device_get" \
+                    and fn.split(".")[0] == "jax":
+                return call.args[0].id
         return None
