@@ -49,6 +49,9 @@ class _EngineState:
     # Optimizer.set_steps_per_dispatch
     steps_per_dispatch: int = field(
         default_factory=_default_steps_per_dispatch)
+    # whether Engine.set_xla_async_collectives has armed the XLA
+    # latency-hiding scheduler flags (None = never touched)
+    xla_async_collectives: Optional[bool] = None
 
 
 class Engine:
@@ -125,3 +128,111 @@ class Engine:
         if int(k) < 1:
             raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
         cls._state.steps_per_dispatch = int(k)
+
+    # -- XLA collective scheduling ----------------------------------------
+    # The grad_sync design (parallel/grad_sync.py) leans on XLA's
+    # latency-hiding scheduler to overlap per-bucket reduce-scatter /
+    # all-gather with backward compute.  On TPU that scheduling is
+    # governed by XLA flags that must be set BEFORE the backend
+    # initializes; this is the one documented place to flip them.
+    _ASYNC_COLLECTIVE_FLAGS = (
+        "--xla_tpu_enable_latency_hiding_scheduler",
+        "--xla_tpu_enable_async_collective_fusion",
+    )
+
+    @classmethod
+    def set_xla_async_collectives(cls, enable: bool = True,
+                                  force: bool = False) -> None:
+        """Arm (or disarm) XLA's async-collective / latency-hiding
+        scheduler flags via ``XLA_FLAGS``.  Call BEFORE the first jax
+        computation — XLA reads the env once at backend init.
+
+        The flags are TPU-build flags, and XLA ABORTS the whole process
+        at backend init on flags its build doesn't know ("Unknown flags
+        in XLA_FLAGS") — so before committing them to the environment
+        this PROBES a throwaway subprocess with the new env; if that
+        child cannot initialize jax, the intent is recorded
+        (:meth:`xla_async_collectives`) but the env is left alone.
+        Once this process's backend is live the probe is no longer
+        trustworthy either (on a single-tenant TPU the child cannot
+        acquire the chip the parent holds and would read as a bogus
+        refusal), so a late call refuses with that diagnosis.
+        ``force=True`` writes the flags with no probe in both cases
+        (images known to accept them, or tests exercising the
+        plumbing); after backend init they then apply to child
+        processes only."""
+        cls._state.xla_async_collectives = bool(enable)
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if f.split("=")[0] not in cls._ASYNC_COLLECTIVE_FLAGS]
+        val = "true" if enable else "false"
+        flags += [f"{f}={val}" for f in cls._ASYNC_COLLECTIVE_FLAGS]
+        new_flags = " ".join(flags)
+        import logging
+        log = logging.getLogger("bigdl_tpu.engine")
+        if os.environ.get("XLA_FLAGS", "") == new_flags:
+            return  # already committed — nothing to probe or rewrite
+        if not force:
+            if cls._backend_live():
+                log.warning(
+                    "set_xla_async_collectives(%s) after backend init: "
+                    "cannot probe flag acceptance safely (a TPU probe "
+                    "child would fight this process for the chip) nor "
+                    "retrofit the live backend — intent recorded, "
+                    "XLA_FLAGS untouched.  Call before the first jax "
+                    "computation, or force=True to write the flags for "
+                    "child processes only", enable)
+                return
+            if not cls._xla_flags_survive(new_flags):
+                log.warning(
+                    "set_xla_async_collectives(%s): this jaxlib fatally "
+                    "rejects the async-collective flags — intent "
+                    "recorded, XLA_FLAGS untouched (force=True "
+                    "overrides)", enable)
+                return
+        os.environ["XLA_FLAGS"] = new_flags
+        if cls._backend_live():
+            log.warning(
+                "set_xla_async_collectives(%s) after backend init: flags "
+                "apply to child processes only (XLA reads XLA_FLAGS once)",
+                enable)
+
+    @staticmethod
+    def _backend_live() -> bool:
+        """Whether this process's jax backend has already initialized
+        (and therefore already consumed ``XLA_FLAGS``)."""
+        try:
+            from jax._src import xla_bridge
+            return bool(getattr(xla_bridge, "_backends", None))
+        except Exception:  # pragma: no cover - jax internals moved
+            return False
+
+    @staticmethod
+    def _xla_flags_survive(xla_flags: str) -> bool:
+        """Probe whether a jax process on this machine survives the
+        given ``XLA_FLAGS`` (XLA's flag parser aborts the PROCESS on
+        unknown flags, so this cannot be tested in-process)."""
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = xla_flags
+        # the probe inherits the DEFAULT backend choice: the known-flag
+        # registry is per backend binary (libtpu knows --xla_tpu_*
+        # flags, a CPU-only jaxlib does not), so a CPU-pinned child
+        # would reject flags the real target accepts.  Tradeoff: on a
+        # single-tenant TPU the child must be able to acquire the chip,
+        # which is why this surface is documented as
+        # call-before-the-first-jax-computation; a child that cannot
+        # init reads as "refuse" (safe: flags just stay unset).
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env=env, capture_output=True, timeout=300)
+        except Exception:  # pragma: no cover - probe infrastructure
+            return False
+        return r.returncode == 0
+
+    @classmethod
+    def xla_async_collectives(cls) -> Optional[bool]:
+        """Last value passed to :meth:`set_xla_async_collectives`
+        (None = untouched defaults)."""
+        return cls._state.xla_async_collectives

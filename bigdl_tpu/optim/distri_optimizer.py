@@ -15,17 +15,25 @@ analog of BigDL 2.0 hiding the per-iteration Spark job dispatch cost).
 - The global batch rides the ``data`` mesh axis (the analog of one data
   partition per executor); a staged K-step block is sharded
   ``P(None, "data")`` — step axis replicated, batch axis sharded.
-- Params are replicated; XLA inserts the gradient AllReduce over ICI when
-  it sees sharded-batch grads meet replicated params — replacing
-  ``putGradients``/``aggregateGradientPartition`` (+ its FP16 wire format:
-  ICI needs no software compression).
-- With ``parameter_sharding=True`` (default), optimizer state is sharded
-  over the mesh via sharding annotations, so XLA emits reduce-scatter +
-  sharded update + all-gather — the exact ZeRO-1 pattern of
-  ``AllReduceParameter`` (each node owns 1/N of the flat vector and runs
-  the optimizer on its slice only, ``AllReduceParameter.scala:73-76``).
-  (See also "Automatic Cross-Replica Sharding of Weight Update in
-  Data-Parallel Training", arXiv:2004.13336 — the same design.)
+- With ``parameter_sharding=True`` (default, pure DP), gradient sync is
+  the EXPLICIT bucketed protocol of ``parallel/grad_sync.py`` — the
+  TPU-native ``AllReduceParameter`` + ``FP16CompressedTensor``:
+  size-capped grad buckets reduce-scatter over ``data`` in a
+  configurable wire dtype (``Config.grad_wire_dtype``: f32|bf16|f16,
+  unbiased stochastic-rounded downcast), each chip runs the optimizer
+  on its owned f32 master slice (ZeRO-1, ``AllReduceParameter.scala:
+  73-76``; arXiv:2004.13336), and updated params all-gather back in the
+  wire dtype — all inside ``shard_map`` within the fused K-step jit so
+  XLA's latency-hiding scheduler overlaps per-bucket collectives with
+  backward compute.  An early revision left gradient aggregation to
+  GSPMD's implicit f32 all-reduce on the assumption that ICI makes
+  software compression unnecessary — BENCH r05 measured that
+  assumption WRONG: ``collective_overhead_fraction = 0.32`` at 8 chips
+  (531 ms/step ablated vs 782 ms with collectives), so the wire format
+  earns its keep exactly as it did for the reference over Ethernet.
+- ``parameter_sharding=False`` (or ``grad_sync=False``) keeps the
+  implicit path: params replicated, XLA inserts the f32 gradient
+  AllReduce — the baseline the grad_sync numerics tests gate against.
 - Straggler gradient-dropping (``DistriOptimizer.scala:398-425``) is
   intentionally absent: SPMD collectives are lock-step; XLA's synchronous
   model replaces it (documented divergence, SURVEY.md §7 stage 4).
@@ -48,7 +56,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.parallel import grad_sync
 from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint
+from bigdl_tpu.utils.config import get_config
 
 logger = logging.getLogger("bigdl_tpu.optim")
 
@@ -70,20 +80,36 @@ class DistriOptimizer(Optimizer):
     def __init__(self, model, dataset, criterion, batch_size=None,
                  mesh: Optional[Mesh] = None,
                  parameter_sharding: bool = True,
-                 param_specs=None):
+                 param_specs=None,
+                 grad_sync: Optional[bool] = None,
+                 grad_wire_dtype: Optional[str] = None,
+                 grad_bucket_bytes: Optional[int] = None):
         """``param_specs``: optional pytree of PartitionSpec matching the
         model params — enables tensor parallelism (build with
         ``parallel.tensor_parallel.build_param_specs``).  ``None`` keeps
-        params replicated (pure DP)."""
+        params replicated (pure DP).
+
+        ``grad_sync``: force the explicit bucketed gradient-sync path
+        (parallel/grad_sync.py) on/off; ``None`` (default) enables it
+        whenever ``parameter_sharding`` is on and the run is pure DP
+        (no ``param_specs``, non-``data`` mesh axes all size 1).
+        ``grad_wire_dtype`` ("f32"|"bf16"|"f16") and
+        ``grad_bucket_bytes`` override the ``Config`` defaults."""
         super().__init__(model, dataset, criterion, batch_size)
         self.mesh = mesh or Engine.get_mesh()
         self.parameter_sharding = parameter_sharding
         self.param_specs = param_specs
+        self.grad_sync = grad_sync
+        self.grad_wire_dtype = grad_wire_dtype
+        self.grad_bucket_bytes = grad_bucket_bytes
         self.failure_retry_times = Engine._state.failure_retry_times
         self._param_sh = None
         self._ostate_sh = None
         self._block_sh = None  # P(None, "data"): step axis × batch axis
         self._n_dev = 1
+        self._use_grad_sync = False
+        self._gs_plan = None
+        self._gs_wire = None
 
     # -------------------------------------------------------- shardings
     def _shardings(self, params, ostate):
@@ -92,9 +118,13 @@ class DistriOptimizer(Optimizer):
         param_sh = tmap(lambda _: repl, params) if self.param_specs is None \
             else tmap(lambda sp: NamedSharding(mesh, sp), self.param_specs,
                       is_leaf=lambda x: isinstance(x, P))
-        if self.parameter_sharding and self.param_specs is None:
+        if self._use_grad_sync or (self.parameter_sharding
+                                   and self.param_specs is None):
             # ZeRO-1: shard optimizer state over the data axis (only when
-            # params are replicated — TP already shards the state with them)
+            # params are replicated — TP already shards the state with
+            # them).  grad_sync state (flat master/optimizer buckets,
+            # padded to the data-axis size) lands on the same rule: each
+            # chip holds exactly the slice it owns.
             ostate_sh = tmap(
                 lambda l: NamedSharding(mesh, batch_axis_spec(l, mesh)),
                 ostate)
@@ -112,6 +142,134 @@ class DistriOptimizer(Optimizer):
         else:
             ostate_sh = tmap(lambda _: repl, ostate)
         return repl, param_sh, ostate_sh
+
+    # ---------------------------------------------- explicit grad sync
+    def _resolve_grad_sync(self, mesh: Mesh, params) -> None:
+        """Decide whether this run takes the explicit grad_sync path and
+        build its static bucket plan.  Pure-DP only: tensor parallelism
+        shards the params themselves, so the flat-bucket ZeRO-1 protocol
+        does not apply (those runs keep the constraint-driven path)."""
+        cfg = get_config()
+        pure_dp = (self.param_specs is None and "data" in mesh.axis_names
+                   and all(mesh.shape[a] == 1 for a in mesh.axis_names
+                           if a != "data"))
+        if self.grad_sync is None:
+            use = self.parameter_sharding and pure_dp
+        else:
+            use = bool(self.grad_sync)
+            if use and not pure_dp:
+                raise ValueError(
+                    "grad_sync=True requires a pure data-parallel run "
+                    "(no param_specs, non-data mesh axes of size 1); "
+                    f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}")
+        self._use_grad_sync = use
+        if not use:
+            return
+        if self.grad_clip is not None and self.grad_clip_spec is None:
+            raise ValueError(
+                "grad_sync clips owned slices of the reduced gradient and "
+                "needs a structured clip spec — use "
+                "set_gradient_clipping_by_value/_by_l2_norm (or "
+                "grad_sync=False for a custom grad_clip callable)")
+        self._gs_wire = grad_sync.resolve_wire_dtype(
+            self.grad_wire_dtype or cfg.grad_wire_dtype)
+        self._gs_plan = grad_sync.build_plan(
+            params, mesh.shape["data"],
+            self.grad_bucket_bytes or cfg.grad_bucket_bytes)
+
+    def _check_resumed_opt_state(self, ostate) -> None:
+        """Fail LOUDLY when a retry/resume checkpoint's opt_state was
+        written by the other sync path — the formats differ (grad_sync:
+        ``{"master": [flat buckets], "opt": ...}`` vs per-leaf pytree)
+        and letting the mismatch reach jit tracing produces an opaque
+        KeyError/structure error instead of this message."""
+        is_gs = (isinstance(ostate, dict) and set(ostate) ==
+                 {"master", "opt"} and isinstance(ostate.get("master"),
+                                                  list))
+        if self._use_grad_sync and not is_gs:
+            raise ValueError(
+                "resumed opt_state is not grad_sync-format (expected "
+                "{'master': [...], 'opt': ...}) — the checkpoint was "
+                "written by a non-grad_sync run; resume with the "
+                "matching setting (grad_sync=False / "
+                "parameter_sharding=False) or clear the checkpoint dir")
+        if not self._use_grad_sync and is_gs:
+            raise ValueError(
+                "resumed opt_state is grad_sync-format but this run has "
+                "grad_sync disabled — re-enable it or clear the "
+                "checkpoint dir")
+        if is_gs:
+            want = [(s,) for s in self._gs_plan.bucket_sizes]
+            got = [tuple(m.shape) for m in ostate["master"]]
+            if want != got:
+                raise ValueError(
+                    f"resumed grad_sync masters {got} do not match this "
+                    f"run's bucket plan {want} — mesh size or "
+                    f"grad_bucket_bytes changed since the checkpoint "
+                    f"was written")
+
+    def _build_block_fn(self, grad_fn, k: int):
+        """grad_sync runs: ONE donated jit whose body is a ``shard_map``
+        over the mesh — per-chip forward/backward on the local batch
+        shard, then the explicit reduce-scatter → owned-slice update →
+        all-gather of ``parallel/grad_sync.py`` (K-step ``lax.scan``
+        INSIDE the shard_map, so per-bucket collectives of step j can
+        overlap compute of step j+1 under XLA's latency-hiding
+        scheduler).  Non-grad_sync runs keep the base GSPMD block."""
+        if not self._use_grad_sync:
+            return super()._build_block_fn(grad_fn, k)
+        from functools import partial
+
+        mesh, axis = self.mesh, "data"
+        n = mesh.shape[axis]
+        plan, wire = self._gs_plan, self._gs_wire
+        optim = self.optim_method
+        clip_spec = self.grad_clip_spec if self.grad_clip is not None \
+            else None
+
+        def one_step(params, mstate, ostate, x, y, lr, step, rng):
+            (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
+            params, ostate = grad_sync.sync_and_update(
+                plan, grads, ostate, optim, lr, step,
+                wire_dtype=wire, axis_name=axis, clip_spec=clip_spec)
+            new_mstate = grad_sync.sync_model_state(new_mstate, axis)
+            return params, new_mstate, ostate, \
+                jax.lax.pmean(loss, axis)
+
+        body = self._block_body(one_step, k)
+
+        def ostate_spec(l):
+            # flat bucket leaves (masters + mirrored optimizer state)
+            # shard over `data` — the SAME ownership predicate the host
+            # placement uses (batch_axis_spec), so in_specs can never
+            # disagree with where _optimize_impl put the state
+            return batch_axis_spec(l, mesh, axis)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def block_fn(params, mstate, ostate, xs, ys, lrs, steps, rngs):
+            for leaf in jax.tree_util.tree_leaves(xs):
+                if leaf.shape[1] % n:
+                    raise ValueError(
+                        f"grad_sync needs the batch divisible by the "
+                        f"data axis: got {leaf.shape[1]} rows over "
+                        f"{n} chips — pad/drop the remainder or pass "
+                        f"grad_sync=False")
+            os_spec = tmap(ostate_spec, ostate)
+            in_specs = (tmap(lambda _: P(), params),
+                        tmap(lambda _: P(), mstate),
+                        os_spec,
+                        tmap(lambda _: P(None, axis), xs),
+                        None if ys is None
+                        else tmap(lambda _: P(None, axis), ys),
+                        P(), P(), P())
+            out_specs = (tmap(lambda _: P(), params),
+                         tmap(lambda _: P(), mstate),
+                         os_spec, P())
+            fn = grad_sync.shard_map_compat(body, mesh, in_specs,
+                                            out_specs)
+            return fn(params, mstate, ostate, xs, ys, lrs, steps, rngs)
+
+        return block_fn
 
     def _make_global(self, arr: np.ndarray, sharding: NamedSharding):
         """Per-host local shard → global device array (multi-host safe)."""
@@ -269,9 +427,14 @@ class DistriOptimizer(Optimizer):
             mstate = jax.tree_util.tree_map(jnp.array, self.model._state)
         else:
             params, mstate = self.model.init(init_rng)
+        self._resolve_grad_sync(mesh, params)
         if self._resume_opt_state is not None:
             ostate = self._resume_opt_state
             self._resume_opt_state = None
+            self._check_resumed_opt_state(ostate)
+        elif self._use_grad_sync:
+            ostate = grad_sync.init_state(self._gs_plan, params,
+                                          self.optim_method)
         else:
             ostate = self.optim_method.init_state(params)
         repl, param_sh, ostate_sh = self._shardings(params, ostate)
@@ -285,10 +448,14 @@ class DistriOptimizer(Optimizer):
 
         grad_fn = self._loss_and_grad_fn()
         logger.info(
-            "DistriOptimizer: %d samples/epoch, mesh=%s, zero1=%s",
+            "DistriOptimizer: %d samples/epoch, mesh=%s, grad_sync=%s%s",
             self.dataset.size(),
             dict(zip(mesh.axis_names, mesh.devices.shape)),
-            self.parameter_sharding)
+            self._use_grad_sync,
+            f" (wire={jnp.dtype(self._gs_wire).name}, "
+            f"buckets={self._gs_plan.num_buckets})"
+            if self._use_grad_sync else
+            f" (zero1={self.parameter_sharding})")
 
         params, mstate, ostate = self._train_driver(params, mstate, ostate,
                                                     grad_fn, rng)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.optim.schedules import Default, LearningRateSchedule
+from bigdl_tpu.utils.precision import stochastic_round
 
 tmap = jax.tree_util.tree_map
 
@@ -138,20 +139,10 @@ class SGD(OptimMethod):
         return new_params, {"velocity": vel}
 
 
-def _stochastic_round(x, dtype, key):
-    """Unbiased f32→bf16 rounding: add uniform random low-16 bits, then
-    truncate (bf16 is exactly the top 16 bits of f32).  Plain
-    round-to-nearest would systematically drop momentum updates smaller
-    than half a bf16 ulp; the expectation of this rounding is ``x``."""
-    if x.dtype == dtype:
-        return x
-    if dtype != jnp.bfloat16 or x.dtype != jnp.float32:
-        return x.astype(dtype)
-    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
-    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
-    bits = (bits + noise) & jnp.uint32(0xFFFF0000)
-    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(
-        jnp.bfloat16)
+# the unbiased downcast lives in utils/precision.py (shared with the
+# grad_sync wire format); this alias keeps the historical private name
+# importable for back-compat
+_stochastic_round = stochastic_round
 
 
 class Adam(OptimMethod):

@@ -49,7 +49,6 @@ from __future__ import annotations
 
 import logging
 import time
-from functools import partial
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -139,6 +138,7 @@ class Optimizer:
         self.checkpoint_path: Optional[str] = None
         self.overwrite_checkpoint = True
         self.grad_clip: Optional[Callable] = None
+        self.grad_clip_spec: Optional[tuple] = None
         self.train_summary = None
         self.validation_summary = None
         self.metrics = Metrics()
@@ -192,14 +192,20 @@ class Optimizer:
     def set_gradient_clipping_by_value(self, min_v: float,
                                        max_v: float) -> "Optimizer":
         self.grad_clip = lambda g: clip_by_value(g, min_v, max_v)
+        # structured mirror of the closure: the grad_sync step clips
+        # OWNED SLICES of the reduced gradient, so it needs the clip
+        # kind/bounds, not an opaque pytree callable
+        self.grad_clip_spec = ("value", min_v, max_v)
         return self
 
     def set_gradient_clipping_by_l2_norm(self, max_norm: float) -> "Optimizer":
         self.grad_clip = lambda g: clip_by_global_norm(g, max_norm)
+        self.grad_clip_spec = ("norm", max_norm)
         return self
 
     def disable_gradient_clipping(self) -> "Optimizer":
         self.grad_clip = None
+        self.grad_clip_spec = None
         return self
 
     def set_train_summary(self, summary) -> "Optimizer":
@@ -350,6 +356,39 @@ class Optimizer:
         """Trigger-gated per-parameter summaries (SPMD subclass)."""
 
     # --------------------------------------------------- fused train step
+    def _block_body(self, one_step, k: int):
+        """Wrap ``one_step(params, mstate, ostate, x, y, lr, step, rng)``
+        into the K-block calling convention every block fn shares:
+        ``k == 1`` squeezes the leading step axis off ``xs``/``ys`` and
+        returns the loss as a length-1 vector; ``k > 1`` runs the step
+        under ``lax.scan``.  The returned per-step loss vector is what
+        ``_replay_block`` consumes — this wrapper is the ONE place that
+        encodes the convention (the SPMD grad_sync block builds on the
+        same body, inside a shard_map)."""
+        if k == 1:
+            def body(params, mstate, ostate, xs, ys, lrs, steps, rngs):
+                x = tmap(lambda a: a[0], xs)
+                y = None if ys is None else tmap(lambda a: a[0], ys)
+                params, mstate, ostate, loss = one_step(
+                    params, mstate, ostate, x, y, lrs[0], steps[0],
+                    rngs[0])
+                return params, mstate, ostate, loss[None]
+            return body
+
+        def body(params, mstate, ostate, xs, ys, lrs, steps, rngs):
+            def scan_body(carry, inp):
+                params, mstate, ostate = carry
+                x, y, lr, step, rng = inp
+                params, mstate, ostate, loss = one_step(
+                    params, mstate, ostate, x, y, lr, step, rng)
+                return (params, mstate, ostate), loss
+
+            (params, mstate, ostate), losses = jax.lax.scan(
+                scan_body, (params, mstate, ostate),
+                (xs, ys, lrs, steps, rngs))
+            return params, mstate, ostate, losses
+        return body
+
     def _build_block_fn(self, grad_fn, k: int):
         """One jit'd dispatch covering ``k`` consecutive train steps.
 
@@ -375,30 +414,8 @@ class Optimizer:
             params, ostate = constrain(params, ostate)
             return params, new_mstate, ostate, loss
 
-        if k == 1:
-            @partial(jax.jit, donate_argnums=(0, 1, 2))
-            def block_fn(params, mstate, ostate, xs, ys, lrs, steps, rngs):
-                x = tmap(lambda a: a[0], xs)
-                y = None if ys is None else tmap(lambda a: a[0], ys)
-                params, mstate, ostate, loss = one_step(
-                    params, mstate, ostate, x, y, lrs[0], steps[0], rngs[0])
-                return params, mstate, ostate, loss[None]
-            return block_fn
-
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def block_fn(params, mstate, ostate, xs, ys, lrs, steps, rngs):
-            def body(carry, inp):
-                params, mstate, ostate = carry
-                x, y, lr, step, rng = inp
-                params, mstate, ostate, loss = one_step(
-                    params, mstate, ostate, x, y, lr, step, rng)
-                return (params, mstate, ostate), loss
-
-            (params, mstate, ostate), losses = jax.lax.scan(
-                body, (params, mstate, ostate),
-                (xs, ys, lrs, steps, rngs))
-            return params, mstate, ostate, losses
-        return block_fn
+        return jax.jit(self._block_body(one_step, k),
+                       donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------ driver loop
     def _train_driver(self, params, mstate, ostate, grad_fn, rng):

@@ -1,12 +1,18 @@
-"""bigdl_tpu.parallel — mesh topology, tensor parallelism, sequence
-parallelism (ring attention).
+"""bigdl_tpu.parallel — mesh topology, explicit gradient sync
+(AllReduceParameter analog), tensor parallelism, sequence parallelism
+(ring attention).
 
 Replaces the reference's distributed substrate (Spark BlockManager
-AllReduce, ``DL/parameters/``) with sharding-annotation-driven XLA
-collectives over ICI, and adds the TP/SP strategies the reference lacks
-(SURVEY §2.9).
+AllReduce, ``DL/parameters/``) with XLA collectives over ICI —
+``grad_sync`` is the explicit reduce-scatter/sharded-update/all-gather
+wire-format protocol of ``AllReduceParameter.scala`` — and adds the
+TP/SP strategies the reference lacks (SURVEY §2.9).
 """
 
+from bigdl_tpu.parallel import grad_sync
+from bigdl_tpu.parallel.grad_sync import (
+    BucketPlan, build_plan, resolve_wire_dtype,
+)
 from bigdl_tpu.parallel.mesh import (
     create_mesh, data_sharding, replicated, mesh_shape,
 )

@@ -33,6 +33,20 @@ class Config:
     # Blocks are auto-flushed at epoch/trigger boundaries, so semantics
     # are K-invariant; see README "stepping & input pipeline".
     steps_per_dispatch: int = 1
+    # gradient sync (parallel/grad_sync.py — the AllReduceParameter
+    # analog): grads are flattened into buckets of at most
+    # grad_bucket_bytes (f32 accounting) so per-bucket reduce-scatters
+    # overlap backward compute, and the wire dtype controls the
+    # on-the-wire compression (reference FP16CompressedTensor; BENCH
+    # r05 measured collective_overhead_fraction=0.32 at 8 chips, so
+    # compression matters even over ICI).  "f32" | "bf16" | "f16";
+    # the bf16 wire downcasts with unbiased stochastic rounding; f16
+    # uses round-to-nearest (64x finer ulp) with SATURATION at ±65504
+    # — gradient spikes clamp instead of going inf on the wire (see
+    # utils/precision.stochastic_round, parallel/grad_sync.wire_cast).
+    # The optimizer update always accumulates in f32 master slices.
+    grad_bucket_bytes: int = 4 << 20
+    grad_wire_dtype: str = "f32"
     # numerics
     compute_dtype: str = "float32"     # "bfloat16" flips matmul precision
     matmul_precision: str = "default"  # jax "default"|"high"|"highest"

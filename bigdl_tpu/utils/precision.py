@@ -1,13 +1,20 @@
 """Mixed precision for TPU.
 
-No reference analog (the reference's only precision trick is the FP16 wire
-compression of ``parameters/FP16CompressedTensor.scala``, which ICI makes
-unnecessary) — but bf16 compute is how the MXU reaches peak throughput, so
-the training stack treats it as first-class: **params, optimizer state and
-the update stay f32; forward/backward compute in bf16** (classic mixed
-precision; loss and criterion math in f32 for stable softmax/log).
+The reference's precision trick is the FP16 wire compression of
+``parameters/FP16CompressedTensor.scala``; its TPU analog is the
+``grad_wire_dtype`` knob of ``parallel/grad_sync.py`` (BENCH r05 measured
+a 0.32 collective-overhead fraction at 8 chips — software wire compression
+earns its keep even over ICI).  Additionally, bf16 compute is how the MXU
+reaches peak throughput, so the training stack treats it as first-class:
+**params, optimizer state and the update stay f32; forward/backward
+compute in bf16** (classic mixed precision; loss and criterion math in
+f32 for stable softmax/log).
 
 bf16 needs no loss scaling (same exponent range as f32), unlike fp16.
+
+:func:`stochastic_round` is the ONE shared downcast helper — SGD's
+reduced-precision momentum state and grad_sync's wire downcast both use
+it, so the unbiasedness analysis lives in exactly one place.
 """
 
 from __future__ import annotations
@@ -16,6 +23,26 @@ import jax
 import jax.numpy as jnp
 
 tmap = jax.tree_util.tree_map
+
+
+def stochastic_round(x, dtype, key):
+    """Unbiased f32→bf16 rounding: add uniform random low-16 bits, then
+    truncate (bf16 is exactly the top 16 bits of f32).  Plain
+    round-to-nearest would systematically drop updates smaller than half
+    a bf16 ulp (momentum accumulation, gradient wire downcast); the
+    expectation of this rounding is ``x``.  Non-(f32→bf16) pairs fall
+    back to round-to-nearest ``astype`` — f16 has 10 mantissa bits, so
+    its ulp is 64× finer and RTN bias is negligible at wire precision.
+    """
+    if x.dtype == dtype:
+        return x
+    if dtype != jnp.bfloat16 or x.dtype != jnp.float32:
+        return x.astype(dtype)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    bits = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(
+        jnp.bfloat16)
 
 
 def cast_floating(tree, dtype):

@@ -20,7 +20,8 @@ size, never the producing tuple's total.
 
 import os
 
-from tools.byte_audit import _operand_text, audit, shape_bytes
+from tools.byte_audit import (_operand_text, audit, collective_wire_bytes,
+                              shape_bytes)
 
 FIX = os.path.join(os.path.dirname(__file__), "fixtures")
 
@@ -85,6 +86,48 @@ class TestWhileGteFixture:
         sizes = [b for b, _, _, _ in instrs]
         assert sizes == sorted(sizes, reverse=True)
         assert sizes[0] == 3 * BIG  # the root add outranks the while
+
+
+class TestCollectiveWireBytes:
+    """Per-collective wire-byte attribution (round-7, the grad_sync
+    wire-format audit).  Twin canned HLOs — identical program, one f32
+    wire, one bf16 — pin the headline invariant: a bf16 wire halves
+    every collective's payload.  The fixtures deliberately put the
+    reduce-scatter/all-gather inside a while (scan) body: the fused
+    K-step driver compiles them there, and an entry-only walk would
+    read zero."""
+
+    MB = 1048576  # one f32[1048576] = 4 MiB payload
+
+    def test_f32_kinds_and_payloads(self):
+        cw = collective_wire_bytes(_load("hlo_wire_f32.txt"))
+        # reduce-scatter charged its OPERAND (full pre-scatter vector)
+        assert cw["reduce-scatter"] == 4 * self.MB
+        # async all-gather-start charged the largest in-flight element
+        # (the gathered result), not the (operand, result) tuple sum;
+        # the fixture carries start+done PAIRS, so these exact equalities
+        # also pin that -done ops are never charged a second time
+        assert cw["all-gather"] == 4 * self.MB
+        assert cw["all-reduce"] == 4 * self.MB
+        assert cw["total"] == 12 * self.MB
+
+    def test_bf16_wire_halves_collective_bytes(self):
+        f32 = collective_wire_bytes(_load("hlo_wire_f32.txt"))
+        bf16 = collective_wire_bytes(_load("hlo_wire_bf16.txt"))
+        for kind in ("reduce-scatter", "all-gather", "all-reduce",
+                     "total"):
+            assert bf16[kind] * 2 == f32[kind], kind
+
+    def test_no_collectives_reads_zero(self):
+        cw = collective_wire_bytes(_load("hlo_while_gte.txt"))
+        assert cw == {"total": 0}
+
+    def test_legacy_async_fixture_consistent(self):
+        # the PR-2 async fixture: one all-reduce-start/done pair on a
+        # f32[1024,1024] — payload is the single aliased buffer
+        cw = collective_wire_bytes(_load("hlo_async_done.txt"))
+        assert cw["all-reduce"] == AR
+        assert cw["total"] == AR
 
 
 class TestAsyncDoneFixture:

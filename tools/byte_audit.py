@@ -165,6 +165,61 @@ def audit(hlo_text: str, top: int):
     return by_op, instrs[:top]
 
 
+# ---------------------------------------------- collective wire bytes
+# (round-7, grad_sync wire-format audit): attribute the bytes each
+# collective puts on the wire, by op kind — the observable that the
+# grad_wire_dtype knob halves.
+_COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
+                     "collective-permute", "all-to-all")
+
+
+def collective_wire_bytes(hlo_text: str) -> dict:
+    """Per-collective-kind wire-payload bytes over the WHOLE module
+    (collectives inside while/scan bodies — where the fused K-step
+    driver puts them — would be invisible to an entry-only walk; as
+    with XLA cost analysis, a loop body is counted ONCE, not per trip).
+
+    Payload model, deliberately simple and dtype-proportional (this
+    exists to compare wire dtypes, not to model ring hops):
+    - ``all-reduce`` / ``all-gather`` / ``collective-permute`` /
+      ``all-to-all``: result bytes;
+    - ``reduce-scatter``: operand bytes (the full pre-scatter vector —
+      its result is 1/N of what crossed the wire);
+    - async ``*-start``: largest element of the in-flight
+      (operand, result) tuple (the payload buffer); ``*-done`` ops are
+      skipped — their start was already charged.
+    Returns ``{kind: bytes, ..., "total": sum}`` (only kinds present).
+    """
+    by_kind: dict = defaultdict(int)
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        _, shape_str, opcode = m.groups()
+        if opcode.endswith("-done"):
+            continue
+        base = opcode[:-6] if opcode.endswith("-start") else opcode
+        if base not in _COLLECTIVE_KINDS:
+            continue
+        if base == "reduce-scatter":
+            b = shape_bytes(_operand_text(line, m.end())) \
+                or shape_bytes(shape_str)
+        elif opcode.endswith("-start"):
+            elems = [_DTYPE_BYTES[dt]
+                     * int(np.prod([int(d) for d in dims.split(",") if d],
+                                   dtype=np.int64))
+                     for dt, dims in _SHAPE_RE.findall(shape_str)
+                     if dt in _DTYPE_BYTES]
+            b = max(elems, default=0)
+        else:
+            b = shape_bytes(shape_str)
+        if b:
+            by_kind[base] += b
+    out = dict(by_kind)
+    out["total"] = sum(by_kind.values())
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--format", default="NHWC", choices=["NHWC", "NCHW"])
@@ -234,6 +289,11 @@ def main():
     print(f"\n-- top {args.top} instructions --")
     for b, opcode, name, shape_str in top_instrs:
         print(f"  {b/1e6:9.1f}MB  {opcode:22s} {name:40s} {shape_str}")
+    cw = collective_wire_bytes(hlo)
+    if cw["total"]:
+        print("\n-- collective wire bytes by op kind (payload model) --")
+        for kind, b in sorted(cw.items(), key=lambda kv: -kv[1]):
+            print(f"  {kind:22s} {b/1e6:10.2f}MB")
 
 
 if __name__ == "__main__":
