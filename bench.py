@@ -987,10 +987,106 @@ def collective_child():
                       "wire_bytes": wire_bytes}))
 
 
+def serving_bench(smoke: bool = False):
+    """Offered-load sweep over the ``bigdl_tpu.serving`` engine.
+
+    Closed-loop load: T caller threads each issue single-row blocking
+    ``predict`` calls back-to-back (the worst coalescing case — every
+    request is 1 row, so occupancy is earned purely by the batcher).
+    Per load point: rows/sec, p50/p95/p99 latency, mean batch occupancy,
+    and dispatches-per-request (1/T is perfect coalescing at T ≤
+    max_batch).  A fresh service per point keeps stats windows clean;
+    warmup (AOT bucket compiles) happens before the timed window, and
+    any steady-state compile is RECORDED as a gate failure — per-point
+    ``recompile_gate: FAIL`` plus top-level
+    ``serving_recompile_gate: FAIL`` — following the bench's
+    record-never-abort discipline (same shape as
+    ``collective_gate_0p38``); the hard assertion lives in
+    ``tests/test_serving.py``.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.serving import InferenceService
+
+    din, n_threads_sweep = 64, (1, 4, 16, 64)
+    per_thread = 25 if smoke else 200
+    model = nn.Sequential(
+        nn.Linear(din, 256), nn.ReLU(), nn.Linear(256, 256), nn.ReLU(),
+        nn.Linear(256, 8), nn.SoftMax())
+    model.initialize(rng=0)
+    spec = ((din,), np.float32)
+    rng = np.random.default_rng(0)
+
+    out = {"metric": "serving_throughput_rows_per_sec",
+           "unit": "rows/sec", "toolchain": _toolchain(),
+           "config": f"mlp{din}x256x256x8/max_batch32/timeout2ms/"
+                     f"single-row-closed-loop", "sweep": []}
+    best = 0.0
+    for n_threads in n_threads_sweep:
+        svc = InferenceService(model, input_spec=spec, max_batch_size=32,
+                               batch_timeout_ms=2.0, queue_capacity=4096,
+                               name=f"bench-load{n_threads}")
+        warm_compiles = svc.compile_count
+        xs = [rng.normal(0, 1, (1, din)).astype(np.float32)
+              for _ in range(n_threads)]
+        barrier = _threading.Barrier(n_threads + 1)
+        errs = []
+
+        def worker(x):
+            barrier.wait()
+            try:
+                for _ in range(per_thread):
+                    svc.predict(x, timeout=120)
+            except Exception as e:  # recorded, never dropped
+                errs.append(f"{type(e).__name__}: {e}")
+
+        threads = [_threading.Thread(target=worker, args=(x,))
+                   for x in xs]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        stats = svc.stats()
+        svc.stop()
+        n_req = n_threads * per_thread
+        point = {
+            "offered_threads": n_threads,
+            "requests": n_req,
+            "throughput_rps": round(n_req / wall, 1),
+            "latency_ms": stats["latency_ms"],
+            "mean_batch_occupancy": stats["mean_batch_occupancy"],
+            "dispatch_count": stats["dispatch_count"],
+            "dispatches_per_request":
+                round(stats["dispatch_count"] / n_req, 4),
+            "steady_state_compiles": svc.compile_count - warm_compiles,
+        }
+        if errs:
+            point["errors"] = errs[:3]
+        if svc.compile_count != warm_compiles:
+            point["recompile_gate"] = "FAIL"  # GL106-for-serving tripped
+        out["sweep"].append(point)
+        best = max(best, point["throughput_rps"])
+    out["value"] = best
+    out["serving_recompile_gate"] = (
+        "FAIL" if any(p.get("recompile_gate") == "FAIL"
+                      for p in out["sweep"]) else "PASS")
+    from bigdl_tpu.serving import row_buckets
+    out["serving_buckets"] = list(row_buckets(32))
+    return out
+
+
 if __name__ == "__main__":
     if "--scaling-child" in sys.argv:
         scaling_child()
     elif "--collective-child" in sys.argv:
         collective_child()
+    elif "--serving" in sys.argv:
+        print(json.dumps(serving_bench("--smoke" in sys.argv)))
     else:
         main(sys.argv[1:])

@@ -129,6 +129,21 @@ class Engine:
             raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
         cls._state.steps_per_dispatch = int(k)
 
+    # -- serving -----------------------------------------------------------
+    @classmethod
+    def serving_defaults(cls) -> dict:
+        """Process-wide defaults for :class:`bigdl_tpu.serving.
+        InferenceService` knobs (config ``serving_*`` fields /
+        ``BIGDL_TPU_SERVING_*`` env); per-service constructor args
+        override."""
+        from bigdl_tpu.utils.config import get_config
+        cfg = get_config()
+        return {
+            "max_batch_size": cfg.serving_max_batch_size,
+            "batch_timeout_ms": cfg.serving_batch_timeout_ms,
+            "queue_capacity": cfg.serving_queue_capacity,
+        }
+
     # -- XLA collective scheduling ----------------------------------------
     # The grad_sync design (parallel/grad_sync.py) leans on XLA's
     # latency-hiding scheduler to overlap per-bucket reduce-scatter /

@@ -7,10 +7,19 @@ an instance pool), ``LocalPredictor.scala``.
 
 TPU redesign: the broadcast/mapPartitions machinery collapses into one
 jit'd forward — the "broadcast" is params living in HBM, "partition-local
-batching" is plain batching.  ``PredictionService``'s instance pool is
-unnecessary: a jit'd function is pure and reentrant, so concurrent callers
-share one compiled executable; the service adds fixed-size batch padding so
-odd request sizes never trigger a recompile.
+batching" is plain batching.  ``PredictionService`` is now a back-compat
+shim over :class:`bigdl_tpu.serving.InferenceService` — the dynamic
+batching engine that coalesces concurrent callers into one bucket-padded
+AOT-compiled dispatch (see the ``serving`` package / README "serving").
+
+Padding invariant (shared with the serving engine): partial batches are
+padded with ZERO rows up to the compiled shape and the pad outputs are
+sliced off.  This is sound because the forward runs in eval mode
+(``training=False``): BatchNorm reads running statistics and dropout is
+off, so rows are computed independently and a pad row cannot perturb a
+real row.  Zero rows (rather than copies of a real row) keep the H2D
+bytes compressible and make a violation of the invariant *visible* —
+copied rows would mask cross-row leakage bit-exactly.
 """
 
 from __future__ import annotations
@@ -35,14 +44,27 @@ def _resolve(model: Module, params, state):
     return params, state if state is not None else {}
 
 
+# the zero-pad/leading-rows helpers are the serving engine's — one
+# implementation of the padding invariant, not two drifting copies
+from bigdl_tpu.serving.service import leading_rows, pad_rows
+
+
 class Predictor:
-    """Batched forward inference (reference ``Predictor.scala``)."""
+    """Batched forward inference (reference ``Predictor.scala``).
+
+    ``input_spec`` (optional): per-row ``jax.ShapeDtypeStruct`` (or
+    ``(shape, dtype)``) of one sample — lets :meth:`predict` return a
+    correctly-shaped empty array for an empty dataset via
+    ``jax.eval_shape`` instead of a rank-less ``(0,)``.
+    """
 
     def __init__(self, model: Module, params=None, state=None,
-                 batch_size: int = 128):
+                 batch_size: int = 128, input_spec=None):
         self.model = model
         self.params, self.state = _resolve(model, params, state)
         self.batch_size = batch_size
+        self.input_spec = input_spec
+        self._rows_track: Optional[bool] = None  # lazily probed
 
         @jax.jit
         def fwd(params, state, x):
@@ -50,6 +72,30 @@ class Predictor:
             return out
 
         self._fwd = fwd
+
+    def _rows_track_input(self, x) -> bool:
+        """Two-point ``jax.eval_shape`` probe (tracing only — no
+        compile): does the output leading dim FOLLOW the input leading
+        dim?  False for COO-style inputs whose output rows come from
+        static metadata (so a single-point ``out_rows == in_rows`` check
+        would be fooled whenever nnz happens to equal the sample
+        count)."""
+
+        def with_rows(k):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct((k,) + a.shape[1:],
+                                               a.dtype), x)
+
+        try:
+            for k in (2, 3):
+                out = jax.eval_shape(self._fwd, self.params, self.state,
+                                     with_rows(k))
+                if any(leaf.shape[:1] != (k,)
+                       for leaf in jax.tree_util.tree_leaves(out)):
+                    return False
+            return True
+        except Exception:
+            return False  # probe shapes unsupported — be conservative
 
     def _iter_batches(self, data):
         if isinstance(data, AbstractDataSet):
@@ -70,16 +116,67 @@ class Predictor:
             if buf:
                 yield batch_samples(buf)
 
+    def _empty_result(self) -> np.ndarray:
+        """Empty input → empty output with the model's true trailing
+        dims, recovered abstractly (no device work, no compile) when the
+        caller declared an ``input_spec``."""
+        if self.input_spec is None:
+            return np.empty((0,))
+        from bigdl_tpu.serving.service import InferenceService
+        row = InferenceService._normalize_row_spec(self.input_spec)
+        spec1 = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct((1,) + tuple(s.shape), s.dtype),
+            row)
+        out = jax.eval_shape(self._fwd, self.params, self.state, spec1)
+        return np.empty((0,) + tuple(out.shape[1:]),
+                        dtype=np.dtype(out.dtype))
+
     def predict(self, data) -> np.ndarray:
         """data: AbstractDataSet (yielding MiniBatch) or iterable of
         Samples/arrays.  Returns stacked outputs (reference
-        ``model.predict(rdd)`` → RDD[Activity])."""
+        ``model.predict(rdd)`` → RDD[Activity]).
+
+        The trailing partial batch is zero-padded up to the steady-state
+        batch shape and the pad rows sliced off, so a whole-dataset
+        predict compiles exactly ONE executable (the unbucketed tail
+        shape was a second silent compile — graftlint GL106's hazard
+        class; regression-gated in ``tests/test_serving.py``)."""
         outs = []
+        steady = None  # rows of the first (steady-state) batch
         for batch in self._iter_batches(data):
             x = jax.tree_util.tree_map(jnp.asarray, batch.input)
-            outs.append(np.asarray(self._fwd(self.params, self.state, x)))
+            try:
+                n = leading_rows(x)
+            except ValueError:
+                # heterogeneous leading dims — e.g. SparseMiniBatch's
+                # (coo(nnz), dense(N)) inputs: no row accounting is
+                # possible, dispatch as-is (the historical behavior)
+                outs.append(np.asarray(
+                    self._fwd(self.params, self.state, x)))
+                continue
+            if steady is None:
+                steady = n
+            if n < steady:
+                # tail batch: pad-to-steady-and-slice saves the second
+                # compile, but ONLY when output rows provably follow
+                # input rows (eval_shape probe — a COO-only input whose
+                # nnz bucket coincides with the sample count would fool
+                # any single-point check and lose real rows); otherwise
+                # dispatch the odd shape as-is: one extra compile,
+                # never a wrong answer
+                if self._rows_track is None:
+                    self._rows_track = self._rows_track_input(x)
+                if self._rows_track:
+                    x = jax.tree_util.tree_map(jnp.asarray,
+                                               pad_rows(x, steady))
+                    out = np.asarray(self._fwd(self.params, self.state,
+                                               x))
+                    outs.append(out[:n])
+                    continue
+            outs.append(np.asarray(
+                self._fwd(self.params, self.state, x)))
         if not outs:
-            return np.empty((0,))
+            return self._empty_result()
         return np.concatenate(outs, axis=0)
 
     def predict_class(self, data) -> np.ndarray:
@@ -119,40 +216,50 @@ class Evaluator:
 
 class PredictionService:
     """Thread-safe always-on inference endpoint (reference
-    ``PredictionService.scala``).  Requests of any size ≤ batch_size are
-    padded to the fixed compiled shape (no recompilation storms); larger
-    requests are chunked.  Safe for concurrent callers — jit'd executables
-    are reentrant, so unlike the reference no instance pool is needed."""
+    ``PredictionService.scala``) — back-compat shim over
+    :class:`bigdl_tpu.serving.InferenceService`.
+
+    The old implementation ran one padded batch-32 dispatch *per caller
+    thread*: 8 concurrent single-row requests burned 8 full forwards.
+    The serving engine coalesces concurrent callers into one bucketed
+    dispatch, adds bounded-queue backpressure
+    (:class:`bigdl_tpu.serving.ServiceOverloaded`), AOT bucket warmup and
+    per-model stats; this shim keeps the historical constructor and the
+    blocking ``predict`` + ``request_count`` surface.  New code should
+    use :class:`~bigdl_tpu.serving.InferenceService` directly (futures,
+    ``stats()``, ``stop()``)."""
 
     def __init__(self, model: Module, params=None, state=None,
-                 batch_size: int = 32):
+                 batch_size: int = 32, **service_kw):
+        from bigdl_tpu.serving import InferenceService
         self.model = model
         self.params, self.state = _resolve(model, params, state)
         self.batch_size = batch_size
         self._stats_lock = threading.Lock()
         self.request_count = 0
+        # timeout 0 = adaptive batching: the historical service
+        # dispatched immediately, so the shim must not tax lone
+        # sequential callers with a coalescing wait — concurrent load
+        # still coalesces (whatever queued during the previous dispatch
+        # forms the next group); override via batch_timeout_ms=...
+        service_kw.setdefault("batch_timeout_ms", 0.0)
+        self.service = InferenceService(
+            model, self.params, self.state, max_batch_size=batch_size,
+            name="PredictionService", **service_kw)
 
-        @jax.jit
-        def fwd(params, state, x):
-            out, _ = model.apply(params, state, x, training=False)
-            return out
-
-        self._fwd = fwd
-
-    def predict(self, features: np.ndarray) -> np.ndarray:
-        """features: (n, ...) with any n ≥ 1."""
-        features = np.asarray(features)
-        n = features.shape[0]
-        outs = []
-        for off in range(0, n, self.batch_size):
-            chunk = features[off:off + self.batch_size]
-            pad = self.batch_size - chunk.shape[0]
-            if pad:
-                chunk = np.concatenate(
-                    [chunk, np.repeat(chunk[-1:], pad, axis=0)], axis=0)
-            out = np.asarray(self._fwd(self.params, self.state,
-                                       jnp.asarray(chunk)))
-            outs.append(out[:self.batch_size - pad] if pad else out)
+    def predict(self, features) -> np.ndarray:
+        """features: (n, ...) with any n ≥ 1 (chunked over the engine's
+        coalesced bucket dispatches).  Coerced via ``np.asarray`` like
+        the historical implementation, so list-of-lists inputs keep
+        working (the engine itself would read a nested list as a
+        pytree of scalars)."""
+        out = self.service.predict(np.asarray(features))
         with self._stats_lock:
             self.request_count += 1
-        return np.concatenate(outs, axis=0)
+        return out
+
+    def stats(self) -> dict:
+        return self.service.stats()
+
+    def stop(self, drain: bool = True) -> None:
+        self.service.stop(drain=drain)

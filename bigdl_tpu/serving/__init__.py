@@ -1,0 +1,40 @@
+"""bigdl_tpu.serving — TPU-native dynamic-batching inference engine.
+
+The serving analog of the training stack's four perf PRs: where training
+got K-step dispatch fusion and bucketed collectives, inference gets
+request coalescing (one device dispatch serves many concurrent callers),
+AOT-compiled power-of-two row buckets (steady-state traffic never
+recompiles — the GL106 discipline applied to serving), bounded-queue
+backpressure (``ServiceOverloaded``), graceful drain-then-stop shutdown,
+and per-model stats (throughput, p50/p95/p99 latency, batch occupancy,
+queue depth, dispatch count).
+
+Reference lineage: BigDL 2.0 Cluster Serving (arXiv:2204.01715) and the
+reference repo's ``PredictionService.scala`` — whose Python twin in
+``optim/predictor.py`` is now a thin shim over this engine.
+
+    from bigdl_tpu.serving import InferenceService
+    svc = InferenceService(model, input_spec=((16,), np.float32))
+    fut = svc.submit(x)            # Future; coalesced with other callers
+    y = svc.predict(x)             # blocking sugar (chunks big inputs)
+    svc.stats()                    # schema in README "serving"
+    svc.stop()                     # drain then stop
+
+    from bigdl_tpu.serving import ModelRegistry
+    reg = ModelRegistry()
+    reg.deploy("textclf", model, input_spec=..., quantize=True)
+    reg.predict("textclf", x)      # newest version
+"""
+
+from bigdl_tpu.serving.batcher import (
+    RequestBatcher, ServiceClosed, ServiceOverloaded,
+)
+from bigdl_tpu.serving.metrics import LatencyReservoir, ServingMetrics
+from bigdl_tpu.serving.registry import ModelRegistry
+from bigdl_tpu.serving.service import InferenceService, pad_rows, row_buckets
+
+__all__ = [
+    "InferenceService", "ModelRegistry", "RequestBatcher",
+    "ServiceClosed", "ServiceOverloaded", "ServingMetrics",
+    "LatencyReservoir", "row_buckets",
+]

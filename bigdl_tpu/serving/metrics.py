@@ -1,0 +1,132 @@
+"""Serving observability: per-model counters + latency percentiles.
+
+Reference: BigDL 2.0 Cluster Serving exposes per-model throughput/latency
+through its dashboard (arXiv:2204.01715 §4); the reference
+``PredictionService.scala`` tracks nothing but a request count.  Here every
+:class:`~bigdl_tpu.serving.InferenceService` owns one :class:`ServingMetrics`
+and surfaces it as a plain-dict snapshot (``service.stats()``) so callers can
+ship it to whatever metrics sink they run.
+
+Everything is host-side bookkeeping — nothing here touches jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class LatencyReservoir:
+    """Fixed-size ring of recent request latencies (seconds).
+
+    A bounded ring instead of an unbounded list: an always-on endpoint
+    must not grow memory with request count.  Percentiles are computed
+    over the retained window (the most recent ``capacity`` requests),
+    which is the standard sliding-window SLO estimator.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        self._buf = [0.0] * capacity
+        self._n = 0          # total ever recorded
+        self._lock = threading.Lock()
+
+    def record(self, latency_s: float) -> None:
+        with self._lock:
+            self._buf[self._n % len(self._buf)] = latency_s
+            self._n += 1
+
+    def percentiles(self, qs=(50, 95, 99)) -> Optional[Dict[str, float]]:
+        with self._lock:
+            n = min(self._n, len(self._buf))
+            if n == 0:
+                return None
+            window = sorted(self._buf[:n])
+        out = {}
+        for q in qs:
+            # nearest-rank percentile over the window
+            idx = min(n - 1, max(0, int(round(q / 100.0 * n)) - 1))
+            out[f"p{q}"] = window[idx]
+        out["mean"] = sum(window) / n
+        out["max"] = window[-1]
+        return out
+
+
+class ServingMetrics:
+    """Thread-safe counters for one deployed model.
+
+    ``mean_batch_occupancy`` is real rows / dispatched (bucket) rows —
+    1.0 means every padded slot carried a real request, 1/bucket means
+    the batcher is dispatching singletons (no coalescing win).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started_at = time.monotonic()
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.cancelled = 0
+        self.dispatches = 0
+        self.rows_real = 0       # rows carrying actual requests
+        self.rows_dispatched = 0  # bucket rows sent to the device
+        self.latency = LatencyReservoir()
+
+    # -- recording (called from submit / batcher threads) -----------------
+    def record_submit(self, rows: int) -> None:
+        with self._lock:
+            self.submitted += rows
+
+    def record_reject(self, rows: int = 1) -> None:
+        with self._lock:
+            self.rejected += rows
+
+    def record_dispatch(self, real_rows: int, bucket_rows: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.rows_real += real_rows
+            self.rows_dispatched += bucket_rows
+
+    def record_done(self, rows: int, latency_s: float) -> None:
+        with self._lock:
+            self.completed += rows
+        self.latency.record(latency_s)
+
+    def record_failure(self, rows: int) -> None:
+        with self._lock:
+            self.failed += rows
+
+    def record_cancel(self, rows: int) -> None:
+        with self._lock:
+            self.cancelled += rows
+
+    # -- snapshot ----------------------------------------------------------
+    def snapshot(self, queue_depth: int = 0,
+                 compile_count: int = 0) -> dict:
+        """Plain-dict stats (the ``service.stats()`` schema documented in
+        the README serving section).  Latencies are reported in ms."""
+        with self._lock:
+            elapsed = max(time.monotonic() - self.started_at, 1e-9)
+            occ = (self.rows_real / self.rows_dispatched
+                   if self.rows_dispatched else None)
+            snap = {
+                "requests_submitted": self.submitted,
+                "requests_completed": self.completed,
+                "requests_rejected": self.rejected,
+                "requests_failed": self.failed,
+                "requests_cancelled": self.cancelled,
+                "dispatch_count": self.dispatches,
+                "rows_dispatched": self.rows_dispatched,
+                "mean_batch_occupancy":
+                    round(occ, 4) if occ is not None else None,
+                "throughput_rps": round(self.completed / elapsed, 2),
+                "queue_depth": queue_depth,
+                "compile_count": compile_count,
+                "uptime_s": round(elapsed, 3),
+            }
+        pct = self.latency.percentiles()
+        snap["latency_ms"] = (
+            {k: round(v * 1e3, 3) for k, v in pct.items()}
+            if pct else None)
+        return snap

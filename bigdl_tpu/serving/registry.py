@@ -1,0 +1,201 @@
+"""ModelRegistry — named/versioned deployment of InferenceServices.
+
+Reference: BigDL 2.0 Cluster Serving deploys models by name into a
+shared cluster and routes by model id (arXiv:2204.01715 §3.1); the
+reference mono-model ``PredictionService.scala`` has no registry at all.
+Here one registry process hosts many models, each behind its own
+:class:`~bigdl_tpu.serving.InferenceService` (own queue, own buckets,
+own stats), deployable either from an in-memory Module or straight from
+the interop wire formats (BigDL / Caffe / TF / Keras / Torch — the same
+loaders ``interop.convert_model`` uses), optionally int8-quantized via
+``nn.quantized.quantize`` on the way in.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.serving.service import InferenceService
+
+
+def _load_model(fmt: str, path: str, *, prototxt: Optional[str] = None,
+                weights: Optional[str] = None,
+                tf_inputs: Optional[List[str]] = None,
+                tf_outputs: Optional[List[str]] = None):
+    """Load a model from an interop wire format (mirror of
+    ``interop.convert_model._load``, keyword-driven)."""
+    fmt = fmt.lower()
+    if fmt == "bigdl":
+        from bigdl_tpu.interop import load_bigdl_module
+        return load_bigdl_module(path)
+    if fmt == "caffe":
+        if not prototxt:
+            raise ValueError("format='caffe' requires prototxt=")
+        from bigdl_tpu.interop import load_caffe_model
+        return load_caffe_model(prototxt, path)
+    if fmt == "torch":
+        from bigdl_tpu.interop.torch_export import load_torch_module
+        return load_torch_module(path)
+    if fmt in ("tf", "tensorflow"):
+        if not (tf_inputs and tf_outputs):
+            raise ValueError(
+                "format='tensorflow' requires tf_inputs= and tf_outputs=")
+        from bigdl_tpu.interop import load_tf_graph
+        return load_tf_graph(path, inputs=tf_inputs, outputs=tf_outputs)
+    if fmt == "keras":
+        from bigdl_tpu.interop import load_keras_json
+        model = load_keras_json(path)
+        if weights:
+            from bigdl_tpu.interop import load_keras_hdf5_weights
+            load_keras_hdf5_weights(model, weights)
+        return model.core_module()
+    raise ValueError(f"unknown serving model format {fmt!r}; expected "
+                     "bigdl|caffe|torch|tensorflow|keras")
+
+
+class ModelRegistry:
+    """Thread-safe name → version → service map.
+
+    ``deploy`` auto-increments the version per name (or takes an
+    explicit one); ``get``/``predict`` default to the newest version so
+    rolling upgrades are deploy-new-then-undeploy-old with no caller
+    change.  ``undeploy`` drains the service before dropping it.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._services: Dict[Tuple[str, int], InferenceService] = {}
+        self._latest: Dict[str, int] = {}
+        # keys mid-deploy (reserved before the slow AOT warmup)
+        self._pending: set[Tuple[str, int]] = set()
+
+    # -- deployment --------------------------------------------------------
+    def deploy(self, name: str, model=None, *, path: Optional[str] = None,
+               format: Optional[str] = None, version: Optional[int] = None,
+               params=None, state=None, quantize: bool = False,
+               prototxt: Optional[str] = None,
+               weights: Optional[str] = None,
+               tf_inputs: Optional[List[str]] = None,
+               tf_outputs: Optional[List[str]] = None,
+               **service_kw) -> InferenceService:
+        """Deploy ``model`` (or load one from ``path``/``format``) as
+        ``name``:``version``.  ``service_kw`` flows to
+        :class:`InferenceService` (``input_spec`` for deploy-time AOT
+        warmup, batching/backpressure knobs, ``start=False``...)."""
+        if model is None:
+            if path is None or format is None:
+                raise ValueError("deploy() needs model= or path=+format=")
+            model = _load_model(format, path, prototxt=prototxt,
+                                weights=weights, tf_inputs=tf_inputs,
+                                tf_outputs=tf_outputs)
+        if quantize:
+            from bigdl_tpu.nn.quantized import quantize as _quantize
+            model = _quantize(model)
+            params = state = None  # quantized twin re-owns its weights
+        # reserve the (name, version) key BEFORE the (slow, lock-free)
+        # AOT warmup in the service constructor: two concurrent deploys
+        # must not pick the same auto-version and silently overwrite
+        # (orphaning the loser's batcher thread)
+        with self._lock:
+            if version is None:
+                pending = [v for (n, v) in self._pending if n == name]
+                version = max([self._latest.get(name, 0), *pending]) + 1
+            key = (name, int(version))
+            if key in self._services or key in self._pending:
+                raise ValueError(
+                    f"model {name!r} version {version} already deployed; "
+                    "undeploy it first or bump the version")
+            self._pending.add(key)
+        try:
+            service = InferenceService(
+                model, params, state, name=f"{name}:v{version}",
+                **service_kw)
+        except BaseException:
+            with self._lock:
+                self._pending.discard(key)
+            raise
+        with self._lock:
+            self._pending.discard(key)
+            self._services[key] = service
+            self._latest[name] = max(self._latest.get(name, 0),
+                                     int(version))
+        return service
+
+    # -- lookup ------------------------------------------------------------
+    def _resolve(self, name: str, version: Optional[int]) -> Tuple[str, int]:
+        """Caller must hold ``self._lock`` (so error paths below must
+        not re-take it — ``self._lock`` is not reentrant)."""
+        if version is None:
+            if name not in self._latest:
+                raise KeyError(f"no model {name!r} deployed; have "
+                               f"{sorted(self._latest)}")
+            version = self._latest[name]
+        key = (name, int(version))
+        if key not in self._services:
+            have = sorted(v for (n, v) in self._services if n == name)
+            raise KeyError(f"model {name!r} has no version {version}; "
+                           f"deployed: {have}")
+        return key
+
+    def get(self, name: str,
+            version: Optional[int] = None) -> InferenceService:
+        with self._lock:
+            return self._services[self._resolve(name, version)]
+
+    def predict(self, name: str, x, version: Optional[int] = None,
+                timeout: Optional[float] = None):
+        return self.get(name, version).predict(x, timeout=timeout)
+
+    def submit(self, name: str, x, version: Optional[int] = None):
+        return self.get(name, version).submit(x)
+
+    def list_models(self) -> Dict[str, List[int]]:
+        with self._lock:
+            out: Dict[str, List[int]] = {}
+            for (n, v) in self._services:
+                out.setdefault(n, []).append(v)
+            return {n: sorted(vs) for n, vs in out.items()}
+
+    # -- teardown ----------------------------------------------------------
+    def undeploy(self, name: str, version: Optional[int] = None,
+                 drain: bool = True) -> None:
+        """Stop (drain by default) and drop one version — or every
+        version of ``name`` when ``version`` is None."""
+        with self._lock:
+            if version is None:
+                keys = [k for k in self._services if k[0] == name]
+                if not keys:
+                    raise KeyError(f"no model {name!r} deployed")
+            else:
+                keys = [self._resolve(name, version)]
+            doomed = [self._services.pop(k) for k in keys]
+            remaining = [v for (n, v) in self._services if n == name]
+            if remaining:
+                self._latest[name] = max(remaining)
+            else:
+                self._latest.pop(name, None)
+        for svc in doomed:
+            svc.stop(drain=drain)
+
+    def stats(self) -> Dict[str, dict]:
+        """``{"name:vN": service-stats}`` across every deployment — the
+        registry-wide snapshot a metrics scraper exports."""
+        with self._lock:
+            services = dict(self._services)
+        return {f"{n}:v{v}": svc.stats()
+                for (n, v), svc in sorted(services.items())}
+
+    def stop_all(self, drain: bool = True) -> None:
+        with self._lock:
+            services = list(self._services.values())
+            self._services.clear()
+            self._latest.clear()
+        for svc in services:
+            svc.stop(drain=drain)
+
+    def __enter__(self) -> "ModelRegistry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop_all(drain=True)

@@ -1,0 +1,482 @@
+"""InferenceService — dynamic batching over AOT-compiled bucket executables.
+
+The TPU-native serving contract (README "serving"):
+
+- **One compiled forward per row-bucket, compiled at deploy time.**
+  Steady-state traffic must never trace or compile: coalesced batches
+  are padded up to the nearest power-of-two row bucket and every bucket
+  executable is built up-front with ``jax.jit(...).lower(...).compile()``
+  — the same recompile-hazard discipline graftlint GL106 enforces for
+  training loops, applied to the serving path (catalog note in
+  ``tools/graftlint/README.md``).
+- **Zero padding, sliced off.**  Padded rows are zeros, never copies of
+  real rows: the invariant inference relies on is that the forward is
+  row-independent in eval mode (BatchNorm uses running stats, dropout is
+  off), so pad values cannot leak into real rows and are simply sliced
+  away.  Zeros keep the H2D transfer compressible and make the invariant
+  auditable — a pad row that *did* influence output would change results
+  between bucket sizes, which the serving tests gate bitwise.
+- **Futures in, backpressure out.**  ``submit`` enqueues and returns a
+  ``concurrent.futures.Future``; a full bounded queue raises
+  ``ServiceOverloaded`` (queue depth in the message) instead of
+  buffering into timeout territory.  ``predict`` is the blocking sugar
+  (and chunks oversized inputs across several requests).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.serving.batcher import (
+    RequestBatcher, ServiceClosed, ServiceOverloaded, _Request,
+)
+from bigdl_tpu.serving.metrics import ServingMetrics
+
+_tree = jax.tree_util
+
+
+def row_buckets(max_batch_size: int) -> Tuple[int, ...]:
+    """Power-of-two row buckets up to ``max_batch_size`` (inclusive —
+    a non-power-of-two max becomes the top bucket so a full coalesced
+    batch never spills into two dispatches)."""
+    bs = []
+    b = 1
+    while b < max_batch_size:
+        bs.append(b)
+        b *= 2
+    bs.append(max_batch_size)
+    return tuple(bs)
+
+
+def leading_rows(x) -> int:
+    leaves = _tree.tree_leaves(x)
+    if not leaves:
+        raise ValueError("empty input pytree")
+    n = leaves[0].shape[0] if leaves[0].ndim else None
+    for leaf in leaves:
+        if leaf.ndim == 0 or leaf.shape[0] != n:
+            raise ValueError(
+                "all input leaves must share one leading batch dim; got "
+                f"shapes {[leaf.shape for leaf in leaves]}")
+    return n
+
+
+def pad_rows(x, target: int):
+    """Zero-pad every leaf's leading dim up to ``target`` rows (see the
+    module docstring for why zeros and not row copies)."""
+
+    def pad(leaf):
+        n = leaf.shape[0]
+        if n == target:
+            return leaf
+        widths = [(0, target - n)] + [(0, 0)] * (leaf.ndim - 1)
+        return np.pad(leaf, widths)
+
+    return _tree.tree_map(pad, x)
+
+
+class InferenceService:
+    """Always-on inference endpoint for one model.
+
+    Parameters
+    ----------
+    model, params, state:
+        Any :class:`~bigdl_tpu.nn.module.Module` (including the
+        ``nn.quantized`` int8 twins and interop-loaded models); params
+        default to the model's own initialized weights.
+    input_spec:
+        Pytree of per-ROW ``jax.ShapeDtypeStruct`` (no batch dim) — or
+        ``(shape, dtype)`` tuples / np arrays — describing one request
+        row.  When given, all bucket executables are AOT-compiled at
+        construction (deploy-time warmup); when ``None``, the spec is
+        captured from the first request and warmup happens then (the
+        back-compat ``PredictionService`` path).
+    max_batch_size / batch_timeout_ms / queue_capacity:
+        Coalescing and backpressure knobs; ``None`` resolves from
+        ``Engine.serving_defaults()`` (config ``serving_*`` fields /
+        ``BIGDL_TPU_SERVING_*`` env).
+    start:
+        ``start=False`` builds the service with the batcher parked —
+        requests queue (bounded) until :meth:`start`.  Used by tests to
+        stage deterministic coalescing, and by deploys that want warmup
+        strictly before traffic.
+    """
+
+    def __init__(self, model, params=None, state=None, *,
+                 input_spec=None, max_batch_size: Optional[int] = None,
+                 batch_timeout_ms: Optional[float] = None,
+                 queue_capacity: Optional[int] = None,
+                 name: str = "model", start: bool = True):
+        from bigdl_tpu.engine import Engine
+        defaults = Engine.serving_defaults()
+        self.model = model
+        if params is None:
+            model._ensure_init()
+            params, state = model._params, model._state
+        self.params = params
+        self.state = state if state is not None else {}
+        self.name = name
+        # `is not None` throughout: an explicit 0 must reach the
+        # batcher's >= 1 validation, not silently become the default
+        self.max_batch_size = int(
+            max_batch_size if max_batch_size is not None
+            else defaults["max_batch_size"])
+        self.batch_timeout_ms = float(
+            batch_timeout_ms if batch_timeout_ms is not None
+            else defaults["batch_timeout_ms"])
+        self.queue_capacity = int(
+            queue_capacity if queue_capacity is not None
+            else defaults["queue_capacity"])
+        self.buckets = row_buckets(self.max_batch_size)
+
+        # the ONE jit for this model; bucket executables are AOT builds
+        # of it.  _trace_count counts Python traces — after warmup it
+        # must never move (gated in tests/test_serving.py).
+        self._trace_count = 0
+
+        def fwd(params, state, x):
+            # trace-time side effect BY DESIGN: runs once per Python
+            # trace (= per compile), never in the compiled program —
+            # it's the compile counter the zero-recompile gate reads
+            self._trace_count += 1  # graftlint: disable=GL103
+            out, _ = model.apply(params, state, x, training=False)
+            return out
+
+        self._jit = jax.jit(fwd)
+        self._compiled: Dict[int, Any] = {}
+        self._warmed = False
+        self._row_spec = None
+        self._out_spec = None
+        self._out_row_shape: Optional[Tuple[int, ...]] = None
+        self._warm_lock = threading.Lock()
+        self._stopped = False
+        self.metrics = ServingMetrics()
+        # a dropped service must not strand its batcher thread for the
+        # life of the process (the historical PredictionService needed
+        # no cleanup, so shim users never call stop()).  For the
+        # finalizer to ever fire, the RUNNING thread must not pin the
+        # service: the batcher gets a WeakMethod shim instead of the
+        # bound `self._dispatch` (the ThreadPoolExecutor pattern) and
+        # the finalize callback closes over the batcher only.  Corner
+        # case (documented): a future whose service was garbage
+        # collected before its dispatch resolves as cancelled — only
+        # reachable by dropping every service reference while blocked
+        # on result(), which predict() can't do (it holds `self`).
+        weak_dispatch = weakref.WeakMethod(self._dispatch)
+
+        def dispatch(requests):
+            fn = weak_dispatch()
+            if fn is None:  # service collected: nothing can resolve these
+                for r in requests:
+                    r.future.cancel()
+                return
+            fn(requests)
+
+        self._batcher = RequestBatcher(
+            dispatch, max_batch_size=self.max_batch_size,
+            batch_timeout_ms=self.batch_timeout_ms,
+            queue_capacity=self.queue_capacity, name=name)
+        self._finalizer = weakref.finalize(
+            self, RequestBatcher.close, self._batcher, True, 5.0)
+        if input_spec is not None:
+            self.warmup(input_spec)
+        if start:
+            self._batcher.start()
+
+    # -- warmup ------------------------------------------------------------
+    @staticmethod
+    def _normalize_row_spec(input_spec):
+        # a (shape, dtype) pair is a LEAF only when shape is a flat
+        # tuple/list of ints — ``(((6,), f32), ((5,), f32))`` stays a
+        # two-leaf pytree, not a shape of ((6,), f32)
+        def is_pair(x):
+            return (isinstance(x, tuple) and len(x) == 2
+                    and isinstance(x[0], (tuple, list))
+                    and all(isinstance(d, (int, np.integer))
+                            for d in x[0]))
+
+        def norm(leaf):
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return leaf
+            if is_pair(leaf):
+                return jax.ShapeDtypeStruct(tuple(leaf[0]),
+                                            jnp.dtype(leaf[1]))
+            arr = np.asarray(leaf)
+            return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+        is_leaf = (lambda x: isinstance(x, (jax.ShapeDtypeStruct,
+                                            np.ndarray)) or is_pair(x))
+        return _tree.tree_map(norm, input_spec, is_leaf=is_leaf)
+
+    def warmup(self, input_spec) -> dict:
+        """AOT-compile every row bucket (idempotent).  Returns
+        ``{bucket: compile_seconds}`` so deploy logs can record the
+        warmup bill."""
+        with self._warm_lock:
+            # gate on the all-buckets-ready flag, NOT on _compiled
+            # being non-empty: a concurrent submitter seeing a
+            # partially-populated dict would dispatch into a KeyError
+            if self._warmed:
+                return {}
+            row = self._normalize_row_spec(input_spec)
+            # output row shape via abstract eval — no device work, runs
+            # BEFORE any compile.  The coalescing contract REQUIRES
+            # output rows to follow input rows (dispatch slices
+            # per-request outputs by input-row offsets), so a model
+            # whose output rows come from static metadata (COO
+            # dense_shape, pooling-over-batch) must be refused at
+            # deploy — without paying the bucket compile bill — not
+            # silently mis-sliced per request; two probe sizes so a
+            # coincidental match can't slip by.
+            for k in (1, 2):
+                speck = _tree.tree_map(
+                    lambda s, _k=k: jax.ShapeDtypeStruct(
+                        (_k,) + s.shape, s.dtype), row)
+                out = jax.eval_shape(self._jit, self.params, self.state,
+                                     speck)
+                bad = [tuple(o.shape) for o in _tree.tree_leaves(out)
+                       if o.shape[:1] != (k,)]
+                if bad:
+                    raise ValueError(
+                        f"model {self.name!r} is not servable by the "
+                        f"coalescing engine: output leading dims {bad} "
+                        f"do not track the input batch dim ({k} rows "
+                        "in) — per-request output slicing would return "
+                        "garbage.  Serve it behind a custom batcher or "
+                        "use Predictor for whole-dataset inference")
+            self._row_spec = row
+            timings = {}
+            for b in self.buckets:
+                spec = _tree.tree_map(
+                    lambda s: jax.ShapeDtypeStruct((b,) + s.shape, s.dtype),
+                    row)
+                t0 = time.monotonic()
+                self._compiled[b] = self._jit.lower(
+                    self.params, self.state, spec).compile()
+                timings[b] = round(time.monotonic() - t0, 4)
+            self._out_spec = _tree.tree_map(
+                lambda o: jax.ShapeDtypeStruct(tuple(o.shape[1:]), o.dtype),
+                out)
+            leaves = _tree.tree_leaves(self._out_spec)
+            self._out_row_shape = (tuple(leaves[0].shape)
+                                   if len(leaves) == 1 else None)
+            self._warmed = True
+            return timings
+
+    @property
+    def warmed_up(self) -> bool:
+        return self._warmed
+
+    @property
+    def compile_count(self) -> int:
+        """Python traces of the forward so far.  Frozen after warmup in
+        steady state — the serving analog of the GL106 gate."""
+        return self._trace_count
+
+    def output_row_shape(self) -> Optional[Tuple[int, ...]]:
+        """Trailing dims of one output row (known after warmup)."""
+        return self._out_row_shape
+
+    # -- request path ------------------------------------------------------
+    def _normalize_input(self, x):
+        xs = _tree.tree_map(np.asarray, x)
+        n = leading_rows(xs)
+        return xs, n
+
+    def _conform_request(self, xs):
+        """Validate a request against the warmed row spec BEFORE it can
+        join a coalesced group: a malformed request must fail alone at
+        submit, not poison every innocent caller batched with it
+        (np.concatenate would either raise for the whole group or
+        silently promote everyone's dtype).  Trailing-shape or
+        tree-structure mismatch raises; dtype mismatch is coerced to
+        the spec dtype (the historical ``jnp.asarray`` behavior — e.g.
+        a float64 numpy default quietly serves as f32)."""
+        spec_leaves, spec_def = _tree.tree_flatten(self._row_spec)
+        req_leaves, req_def = _tree.tree_flatten(xs)
+        if spec_def != req_def or any(
+                leaf.shape[1:] != tuple(s.shape)
+                for leaf, s in zip(req_leaves, spec_leaves)):
+            raise ValueError(
+                f"request does not match the deployed input_spec of "
+                f"{self.name!r}: expected per-row "
+                f"{[(tuple(s.shape), str(s.dtype)) for s in spec_leaves]}"
+                f", got {[leaf.shape[1:] for leaf in req_leaves]}")
+        conformed = [leaf if leaf.dtype == s.dtype
+                     else np.asarray(leaf, dtype=s.dtype)
+                     for leaf, s in zip(req_leaves, spec_leaves)]
+        return _tree.tree_unflatten(req_def, conformed)
+
+    def submit(self, x) -> Future:
+        """Enqueue one request (pytree of arrays, shared leading batch
+        dim ``n`` with ``1 <= n <= max_batch_size``) and return the
+        Future of its stacked outputs.  Raises
+        :class:`ServiceOverloaded` when the bounded queue is full and
+        :class:`ServiceClosed` after :meth:`stop`."""
+        xs, n = self._normalize_input(x)
+        if n == 0:
+            f: Future = Future()
+            f.set_result(self._empty_output())
+            return f
+        if n > self.max_batch_size:
+            raise ValueError(
+                f"request of {n} rows exceeds max_batch_size="
+                f"{self.max_batch_size}; use predict() which chunks")
+        if not self._warmed:
+            # deferred-spec path: capture the row spec from live
+            # traffic (warmup is lock-idempotent, so concurrent first
+            # requests all block until EVERY bucket is compiled)
+            self.warmup(_tree.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), xs))
+        xs = self._conform_request(xs)
+        req = _Request(xs, n)
+        try:
+            self._batcher.put(req)
+        except ServiceOverloaded:
+            self.metrics.record_reject(n)
+            raise
+        self.metrics.record_submit(n)
+        return req.future
+
+    def predict(self, x, timeout: Optional[float] = None):
+        """Blocking sugar over :meth:`submit`; chunks inputs larger than
+        ``max_batch_size`` across several coalescible requests.
+
+        ``timeout`` bounds the WHOLE call (a shared deadline across
+        chunk futures, not per-future).  Chunks are submitted through a
+        bounded in-flight window (≤ half the queue capacity), so an
+        arbitrarily large input never self-overflows the bounded queue
+        the way a submit-everything loop would; overloads caused by
+        *other* callers are absorbed by draining one in-flight chunk
+        and retrying."""
+        xs, n = self._normalize_input(x)
+        if n == 0:
+            return self._empty_output()
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+
+        def remaining() -> Optional[float]:
+            if deadline is None:
+                return None
+            return max(0.0, deadline - time.monotonic())
+
+        if n <= self.max_batch_size:
+            return self.submit(xs).result(remaining())
+        window = max(1, self.queue_capacity // 2)
+        parts: List[Any] = []
+        inflight: List[Future] = []
+        for off in range(0, n, self.max_batch_size):
+            lo, hi = off, off + self.max_batch_size
+            chunk = _tree.tree_map(lambda a: a[lo:hi], xs)
+            if len(inflight) >= window:
+                parts.append(inflight.pop(0).result(remaining()))
+            while True:
+                try:
+                    inflight.append(self.submit(chunk))
+                    break
+                except ServiceOverloaded:
+                    if not inflight:  # foreign traffic owns the queue
+                        raise
+                    parts.append(inflight.pop(0).result(remaining()))
+        parts.extend(f.result(remaining()) for f in inflight)
+        return _tree.tree_map(
+            lambda *ps: np.concatenate(ps, axis=0), *parts)
+
+    def _empty_output(self):
+        if self._out_spec is None:
+            return np.empty((0,))
+        return _tree.tree_map(
+            lambda s: np.empty((0,) + tuple(s.shape), dtype=s.dtype),
+            self._out_spec)
+
+    # -- batcher callback --------------------------------------------------
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.buckets[-1]
+
+    def _dispatch(self, requests: List[_Request]) -> None:
+        """Runs on the batcher thread: coalesce → pad to bucket → one
+        compiled call → slice per-request outputs → resolve futures."""
+        live = [r for r in requests if r.future.set_running_or_notify_cancel()]
+        if not live:
+            return
+        rows = sum(r.n_rows for r in live)
+        try:
+            if len(live) == 1:
+                x = live[0].x
+            else:
+                x = _tree.tree_map(
+                    lambda *leaves: np.concatenate(leaves, axis=0),
+                    *[r.x for r in live])
+            bucket = self._bucket_for(rows)
+            x = pad_rows(x, bucket)
+            out = _tree.tree_map(
+                np.asarray,
+                self._compiled[bucket](self.params, self.state, x))
+            # defense in depth behind the warmup rows-track gate: never
+            # slice per-request offsets out of an output whose leading
+            # dim is not the dispatched bucket — fail the group loudly
+            bad = [o.shape for o in _tree.tree_leaves(out)
+                   if o.shape[:1] != (bucket,)]
+            if bad:
+                raise RuntimeError(
+                    f"output leading dims {bad} != bucket {bucket}; "
+                    "refusing to slice per-request results")
+            self.metrics.record_dispatch(rows, bucket)
+            now = time.monotonic()
+            off = 0
+            for r in live:
+                lo, hi = off, off + r.n_rows
+                r.future.set_result(
+                    _tree.tree_map(lambda o: o[lo:hi], out))
+                self.metrics.record_done(r.n_rows, now - r.t_enqueue)
+                off = hi
+        except Exception as e:  # resolve, never strand, the waiters
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(e)
+                    self.metrics.record_failure(r.n_rows)
+
+    # -- stats / lifecycle -------------------------------------------------
+    def queue_depth(self) -> int:
+        return self._batcher.depth()
+
+    def stats(self) -> dict:
+        """Snapshot dict — schema documented in README "serving"."""
+        snap = self.metrics.snapshot(queue_depth=self._batcher.depth(),
+                                     compile_count=self._trace_count)
+        snap["model"] = self.name
+        snap["max_batch_size"] = self.max_batch_size
+        snap["buckets"] = list(self.buckets)
+        return snap
+
+    def start(self) -> None:
+        self._batcher.start()
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: refuse new submits, drain (default) or
+        cancel the backlog, join the batcher.  Idempotent."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._finalizer.detach()
+        cancelled_rows = self._batcher.close(drain=drain, timeout=timeout)
+        if cancelled_rows:
+            self.metrics.record_cancel(cancelled_rows)
+
+    def __enter__(self) -> "InferenceService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=True)

@@ -47,6 +47,19 @@ class Config:
     # The optimizer update always accumulates in f32 master slices.
     grad_bucket_bytes: int = 4 << 20
     grad_wire_dtype: str = "f32"
+    # serving (bigdl_tpu/serving — dynamic-batching inference engine):
+    # a coalesced batch dispatches when it reaches serving_max_batch_size
+    # rows or serving_batch_timeout_ms after its first request; the
+    # request queue holds at most serving_queue_capacity requests before
+    # submit() raises ServiceOverloaded (explicit backpressure).  The
+    # timeout is the latency/occupancy dial: ~1-5 ms suits interactive
+    # traffic, tens of ms squeezes occupancy out of sparse traffic, 0
+    # is adaptive mode (dispatch whatever is already queued — the
+    # previous dispatch's latency is the coalescing window; the
+    # PredictionService shim runs this way).
+    serving_max_batch_size: int = 32
+    serving_batch_timeout_ms: float = 2.0
+    serving_queue_capacity: int = 256
     # numerics
     compute_dtype: str = "float32"     # "bfloat16" flips matmul precision
     matmul_precision: str = "default"  # jax "default"|"high"|"highest"
