@@ -35,6 +35,7 @@ import numpy as np
 
 from bigdl_tpu.dataset.sample import Sample, MiniBatch
 from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.telemetry.tracer import NULL_SPAN as _NOOP_CM
 from bigdl_tpu.utils.imgops import sample_key
 
 
@@ -69,10 +70,14 @@ class DeviceBlockStager:
     epoch/trigger semantics exact under fusion.
     """
 
-    def __init__(self, batch_iter, place_block):
+    def __init__(self, batch_iter, place_block, tracer=None):
         self._it = batch_iter
         self._place = place_block
         self._held = None  # batch pulled but deferred to the next block
+        # telemetry (optional): a bigdl_tpu.telemetry.Tracer records the
+        # host-stack vs H2D-staging split of every take() — host-side
+        # clock reads only, inert when None
+        self._tracer = tracer
 
     def reset(self, batch_iter) -> None:
         """Point at a fresh iterator (epoch rollover: the driver
@@ -97,43 +102,52 @@ class DeviceBlockStager:
         unlabelled batches.  Raises StopIteration if the host pipeline
         is exhausted with nothing staged (finite iterator misuse — the
         training contract is an infinite shuffled stream)."""
-        batches = []
-        sig = None
-        total = 0
-        while len(batches) < max(1, int(k)) and total < records_budget:
-            if self._held is not None:
-                b, self._held = self._held, None
+        tr = self._tracer
+        span = tr.span if tr is not None else None
+        with span("host_stack", cat="stage") if span else _NOOP_CM:
+            batches = []
+            sig = None
+            total = 0
+            while len(batches) < max(1, int(k)) and total < records_budget:
+                if self._held is not None:
+                    b, self._held = self._held, None
+                else:
+                    try:
+                        b = next(self._it)
+                    except StopIteration:
+                        break
+                if not isinstance(b, MiniBatch):
+                    raise TypeError(
+                        "training dataset must yield MiniBatch (attach "
+                        "SampleToMiniBatch / MTSampleToMiniBatch)")
+                b_sig = batch_signature(b)
+                if sig is None:
+                    sig = b_sig
+                elif b_sig != sig:
+                    self._held = b  # ragged/bucket change: next block's
+                    break           # head
+                batches.append(b)
+                total += b.size()
+            if not batches:
+                raise StopIteration(
+                    "training data iterator exhausted mid-epoch — "
+                    "train=True iterators must be infinite (see "
+                    "AbstractDataSet.data)")
+            import jax
+            tmap = jax.tree_util.tree_map
+            xs = tmap(lambda *ls: np.stack([np.asarray(l) for l in ls]),
+                      *[b.input for b in batches])
+            if batches[0].target is None:
+                ys = None
             else:
-                try:
-                    b = next(self._it)
-                except StopIteration:
-                    break
-            if not isinstance(b, MiniBatch):
-                raise TypeError(
-                    "training dataset must yield MiniBatch (attach "
-                    "SampleToMiniBatch / MTSampleToMiniBatch)")
-            b_sig = batch_signature(b)
-            if sig is None:
-                sig = b_sig
-            elif b_sig != sig:
-                self._held = b  # ragged/bucket change: next block's head
-                break
-            batches.append(b)
-            total += b.size()
-        if not batches:
-            raise StopIteration(
-                "training data iterator exhausted mid-epoch — train=True "
-                "iterators must be infinite (see AbstractDataSet.data)")
-        import jax
-        tmap = jax.tree_util.tree_map
-        xs = tmap(lambda *ls: np.stack([np.asarray(l) for l in ls]),
-                  *[b.input for b in batches])
-        if batches[0].target is None:
-            ys = None
-        else:
-            ys = tmap(lambda *ls: np.stack([np.asarray(l) for l in ls]),
-                      *[b.target for b in batches])
-        dev_xs, dev_ys = self._place(xs, ys)
+                ys = tmap(lambda *ls: np.stack([np.asarray(l) for l in ls]),
+                          *[b.target for b in batches])
+        with span("h2d_stage", cat="stage", k=len(batches)) if span \
+                else _NOOP_CM:
+            # the device_put underneath is ASYNCHRONOUS — this span times
+            # the host-side staging cost, not the DMA itself (the DMA
+            # overlaps the in-flight block's compute by design)
+            dev_xs, dev_ys = self._place(xs, ys)
         return dev_xs, dev_ys, [b.size() for b in batches]
 
 

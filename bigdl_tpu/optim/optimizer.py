@@ -64,6 +64,7 @@ from bigdl_tpu.nn.module import Module
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger, max_epoch, probe_fire_step
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.telemetry import DriverTelemetry, NULL_SPAN, jit_cache_size
 from bigdl_tpu.utils.checkpoint import save_checkpoint
 from bigdl_tpu.utils.metrics import Metrics
 
@@ -100,23 +101,29 @@ class _Staged:
     """A planned, device-placed K'-step block awaiting dispatch."""
 
     __slots__ = ("xs", "ys", "sizes", "lrs", "lrs_dev", "steps_dev",
-                 "rngs_dev", "sync")
+                 "rngs_dev", "sync", "stage_s")
 
     def __init__(self, xs, ys, sizes, lrs, lrs_dev, steps_dev, rngs_dev,
-                 sync):
+                 sync, stage_s=0.0):
         self.xs, self.ys, self.sizes = xs, ys, sizes
         self.lrs, self.lrs_dev = lrs, lrs_dev
         self.steps_dev, self.rngs_dev = steps_dev, rngs_dev
         self.sync = sync  # a trigger/epoch/end boundary ends this block
+        self.stage_s = stage_s  # host time spent planning+staging (telemetry)
 
 
 class _InFlight:
     """A dispatched block whose per-step losses are still on device."""
 
-    __slots__ = ("losses", "sizes", "lrs", "t0")
+    __slots__ = ("losses", "sizes", "lrs", "t0", "stage_s", "dispatch_s",
+                 "first_compile")
 
-    def __init__(self, losses, sizes, lrs, t0):
+    def __init__(self, losses, sizes, lrs, t0, stage_s=0.0,
+                 dispatch_s=0.0, first_compile=False):
         self.losses, self.sizes, self.lrs, self.t0 = losses, sizes, lrs, t0
+        self.stage_s = stage_s        # staging host time (telemetry)
+        self.dispatch_s = dispatch_s  # jit enqueue host time (telemetry)
+        self.first_compile = first_compile  # dispatch included a compile
 
 
 class Optimizer:
@@ -150,6 +157,14 @@ class Optimizer:
         # epoch/neval survive checkpoint/resume)
         self.state: dict = {"epoch": 0, "neval": 0,
                             "records_processed_this_epoch": 0}
+        # telemetry (bigdl_tpu/telemetry): None = resolve from Config at
+        # optimize(); set_telemetry overrides per run.  When enabled the
+        # driver carries a DriverTelemetry in self._telemetry — tracer
+        # spans per pipeline phase, recompile/stall/memory watchdogs —
+        # all host-side and provably inert (no dispatch, no sync).
+        self.telemetry_enabled: Optional[bool] = None
+        self.telemetry_trace_path: Optional[str] = None
+        self._telemetry: Optional[DriverTelemetry] = None
         self._eval_fwd = None  # cached jit'd eval forward
         self._resume_opt_state = None  # optimizer state restored on retry
         self.compute_dtype = None  # None = full f32; jnp.bfloat16 for MXU
@@ -237,6 +252,22 @@ class Optimizer:
         self.steps_per_dispatch = int(k)
         return self
 
+    def set_telemetry(self, enabled: bool = True,
+                      trace_path: Optional[str] = None) -> "Optimizer":
+        """Enable/disable the telemetry subsystem for this run
+        (overrides ``Config.telemetry_enabled`` / ``BIGDL_TPU_TELEMETRY``).
+        ``trace_path``: write the Chrome-trace JSON there when training
+        ends (summarize with ``python -m tools.trace_report``)."""
+        self.telemetry_enabled = bool(enabled)
+        if trace_path is not None:
+            self.telemetry_trace_path = trace_path
+        return self
+
+    def telemetry_snapshot(self) -> Optional[dict]:
+        """Registry + watchdog snapshot of the (last) telemetry-enabled
+        run; None when telemetry was off."""
+        return self._telemetry.snapshot() if self._telemetry else None
+
     def set_state(self, state: dict) -> "Optimizer":
         """Resume driver state (epoch/neval) from a checkpoint."""
         self.state.update(state)
@@ -297,13 +328,24 @@ class Optimizer:
             logger.info("resume: skipped %d already-processed records",
                         skipped)
 
+    def _tel_span(self, name: str, cat: str, **args):
+        """Tracer span when telemetry is on; shared no-op otherwise —
+        the off path allocates nothing."""
+        tel = self._telemetry
+        if tel is None:
+            return NULL_SPAN
+        return tel.tracer.span(name, cat=cat, **args)
+
     def _maybe_checkpoint(self, params, mstate, ostate):
         if self.checkpoint_trigger and self.checkpoint_path \
                 and self.checkpoint_trigger(self.state):
-            f = save_checkpoint(self.checkpoint_path, params, mstate, ostate,
-                                driver_state=self.state,
-                                neval=self.state["neval"],
-                                overwrite=self.overwrite_checkpoint)
+            with self._tel_span("checkpoint", "trigger",
+                                neval=self.state["neval"]):
+                f = save_checkpoint(self.checkpoint_path, params, mstate,
+                                    ostate,
+                                    driver_state=self.state,
+                                    neval=self.state["neval"],
+                                    overwrite=self.overwrite_checkpoint)
             logger.info("checkpoint saved to %s", f)
 
     def _run_validation(self, params, mstate) -> Optional[dict]:
@@ -311,7 +353,9 @@ class Optimizer:
                 and self.validation_dataset is not None
                 and self.validation_trigger(self.state)):
             return None
-        results = self.evaluate_with(params, mstate)
+        with self._tel_span("validation", "trigger",
+                            neval=self.state["neval"]):
+            results = self.evaluate_with(params, mstate)
         for name, res in results.items():
             logger.info("validation %s = %s", name, res)
             if self.validation_summary is not None:
@@ -426,10 +470,32 @@ class Optimizer:
         k_max = self.steps_per_dispatch or Engine.steps_per_dispatch()
         k_max = max(1, int(k_max))
         scale = self._records_scale()
+        # telemetry: resolve the enable knob (per-run override → config),
+        # share the Metrics registry so phase accumulators + watchdog
+        # counters land in one snapshot.  self._telemetry stays None when
+        # off — every call site below is gated on that, so the disabled
+        # path is byte-identical to the pre-telemetry driver.
+        from bigdl_tpu.utils.config import get_config
+        cfg = get_config()
+        tel_on = (self.telemetry_enabled if self.telemetry_enabled
+                  is not None else cfg.telemetry_enabled)
+        tel = None
+        if tel_on:
+            tel = self._telemetry = DriverTelemetry(
+                registry=self.metrics.registry,
+                trace_capacity=cfg.telemetry_trace_capacity,
+                trace_path=(self.telemetry_trace_path
+                            or cfg.telemetry_trace_path or None))
+        else:
+            # drop any bundle from a previous enabled run on this
+            # optimizer — _tel_span/_replay_block read self._telemetry,
+            # so a stale one would keep recording through an "off" run
+            self._telemetry = None
         epoch_size = self._epoch_size = self.dataset.size()
         data_iter = self.dataset.data(train=True)
         self._fast_forward(data_iter, state)
-        stager = DeviceBlockStager(data_iter, self._place_train_block)
+        stager = DeviceBlockStager(data_iter, self._place_train_block,
+                                   tracer=tel.tracer if tel else None)
         self._stager = stager
         # the Parameters-histogram summary trigger is probed too: its
         # firing iteration must end a sync block so the histogram sees
@@ -454,6 +520,7 @@ class Optimizer:
             asynchronous host→device transfer overlap the in-flight
             block's compute — the double buffer."""
             nonlocal rng, bsz_hint
+            t_stage0 = time.perf_counter()
             probe_state = dict(state)
             probe_state.update(
                 neval=p_neval, epoch=p_epoch,
@@ -480,45 +547,66 @@ class Optimizer:
                            jnp.asarray(np.asarray(lrs, np.float32)),
                            jnp.asarray(np.arange(p_neval, p_neval + k,
                                                  dtype=np.int32)),
-                           jnp.stack(keys), sync)
+                           jnp.stack(keys), sync,
+                           stage_s=time.perf_counter() - t_stage0)
 
         pending: Optional[_InFlight] = None
         staged: Optional[_Staged] = None
-        while True:
-            if staged is None:
-                if pending is None and self.end_when(state):
-                    break
-                staged = stage_next()
-            k = len(staged.sizes)
-            fn = block_fns.get(k)
-            if fn is None:
-                fn = block_fns[k] = self._build_block_fn(grad_fn, k)
-            t0 = time.perf_counter()
-            params, mstate, ostate, losses = fn(
-                params, mstate, ostate, staged.xs, staged.ys,
-                staged.lrs_dev, staged.steps_dev, staged.rngs_dev)
-            self._dispatch_count += 1
-            block = _InFlight(losses, staged.sizes, staged.lrs, t0)
-            p_neval += k
-            p_records += sum(staged.sizes) * scale
-            if p_records >= epoch_size:
-                p_epoch += 1
-                p_records = 0
-            sync = staged.sync
-            # double-buffer: next block's H2D lands while this one runs
-            # (a sync block ends at a boundary the replay must handle —
-            # shuffle/validation/stop — before any further staging)
-            staged = stage_next() if not sync else None
-            if pending is not None:
-                ended = self._replay_block(pending, params, mstate, ostate)
-                pending = None
-                if ended:
-                    break
-            if sync:
-                if self._replay_block(block, params, mstate, ostate):
-                    break
-            else:
-                pending = block
+        try:
+            while True:
+                if staged is None:
+                    if pending is None and self.end_when(state):
+                        break
+                    staged = stage_next()
+                k = len(staged.sizes)
+                fn = block_fns.get(k)
+                new_fn = fn is None
+                if new_fn:
+                    fn = block_fns[k] = self._build_block_fn(grad_fn, k)
+                t0 = time.perf_counter()
+                with self._tel_span("dispatch", "dispatch", k=k,
+                                    compile=new_fn):
+                    params, mstate, ostate, losses = fn(
+                        params, mstate, ostate, staged.xs, staged.ys,
+                        staged.lrs_dev, staged.steps_dev, staged.rngs_dev)
+                self._dispatch_count += 1
+                if tel is not None:
+                    # recompile watchdog: the first compile of each block
+                    # length k is the planned one; cache growth after
+                    # that is a steady-state retrace (GL106 at runtime)
+                    tel.recompile.observe(("block_fn", k),
+                                          jit_cache_size(fn))
+                block = _InFlight(losses, staged.sizes, staged.lrs, t0,
+                                  stage_s=staged.stage_s,
+                                  dispatch_s=time.perf_counter() - t0,
+                                  first_compile=new_fn)
+                p_neval += k
+                p_records += sum(staged.sizes) * scale
+                if p_records >= epoch_size:
+                    p_epoch += 1
+                    p_records = 0
+                sync = staged.sync
+                # double-buffer: next block's H2D lands while this one
+                # runs (a sync block ends at a boundary the replay must
+                # handle — shuffle/validation/stop — before any further
+                # staging)
+                staged = stage_next() if not sync else None
+                if pending is not None:
+                    ended = self._replay_block(pending, params, mstate,
+                                               ostate)
+                    pending = None
+                    if ended:
+                        break
+                if sync:
+                    if self._replay_block(block, params, mstate, ostate):
+                        break
+                else:
+                    pending = block
+        finally:
+            if tel is not None:
+                # dump the Chrome trace even on an interrupted run — a
+                # crash timeline is precisely when you want the trace
+                tel.finalize()
         return params, mstate, ostate
 
     def _replay_block(self, block: _InFlight, params, mstate, ostate):
@@ -529,36 +617,76 @@ class Optimizer:
         iterator, exactly as the unfused loop did), validation and
         checkpoint triggers at their exact iteration numbers, and the
         end_when check.  Returns True when training should stop."""
-        with self.metrics.time("computing"):
+        tel = self._telemetry
+        t_wait0 = time.perf_counter()
+        with self.metrics.time("computing"), \
+                self._tel_span("device_wait", "device_wait",
+                               steps=len(block.sizes)):
+            # the driver's one and only device→host sync: the
+            # one-block-behind loss fetch (GL107-safe — the span wraps
+            # the fetch the driver already performs, never adds one)
             losses = np.asarray(jax.device_get(block.losses))
+        t_wait1 = time.perf_counter()
+        if tel is not None:
+            # the block's in-flight window (dispatch → losses landed) on
+            # a virtual "device" track, so Perfetto shows device blocks
+            # overlapping the host phases without breaking span nesting
+            tel.tracer.record("block_inflight", int(block.t0 * 1e9),
+                              int(t_wait1 * 1e9), cat="pipeline",
+                              track="device", steps=len(block.sizes))
         per_step = (time.perf_counter() - block.t0) / len(block.sizes)
         state = self.state
         scale = self._records_scale()
-        for j, n_local in enumerate(block.sizes):
-            n = n_local * scale
-            state["neval"] += 1
-            state["records_processed_this_epoch"] += n
-            state["loss"] = float(losses[j])
-            state["throughput"] = n / per_step
-            lr = block.lrs[j]
-            self._log_train_iteration(lr)
-            if self.train_summary is not None:
-                self.train_summary.add_train_step(
-                    state["neval"], state["loss"], lr, state["throughput"])
-                self._log_parameter_histograms(params)
-            state["epoch_finished"] = \
-                state["records_processed_this_epoch"] >= self._epoch_size
-            if state["epoch_finished"]:
-                state["epoch"] += 1
-                state["records_processed_this_epoch"] = 0
-                self.dataset.shuffle()
-                self._stager.reset(self.dataset.data(train=True))
-            self._run_validation(params, mstate)
-            self._maybe_checkpoint(params, mstate, ostate)
-            state["epoch_finished"] = False
-            if self.end_when(state):
-                return True
-        return False
+        ended = False
+        t_replay0 = time.perf_counter()
+        with self._tel_span("replay", "replay", steps=len(block.sizes)):
+            for j, n_local in enumerate(block.sizes):
+                n = n_local * scale
+                state["neval"] += 1
+                state["records_processed_this_epoch"] += n
+                state["loss"] = float(losses[j])
+                state["throughput"] = n / per_step
+                lr = block.lrs[j]
+                self._log_train_iteration(lr)
+                if self.train_summary is not None:
+                    self.train_summary.add_train_step(
+                        state["neval"], state["loss"], lr,
+                        state["throughput"])
+                    self._log_parameter_histograms(params)
+                state["epoch_finished"] = \
+                    state["records_processed_this_epoch"] >= self._epoch_size
+                if state["epoch_finished"]:
+                    state["epoch"] += 1
+                    state["records_processed_this_epoch"] = 0
+                    self.dataset.shuffle()
+                    self._stager.reset(self.dataset.data(train=True))
+                self._run_validation(params, mstate)
+                self._maybe_checkpoint(params, mstate, ostate)
+                state["epoch_finished"] = False
+                if self.end_when(state):
+                    ended = True
+                    break
+        if tel is not None:
+            tel.stalls.record_block(block.stage_s, block.dispatch_s,
+                                    t_wait1 - t_wait0,
+                                    time.perf_counter() - t_replay0,
+                                    first_compile=block.first_compile)
+            tel.memory.observe()
+            self._mirror_telemetry_scalars(tel)
+        return ended
+
+    def _mirror_telemetry_scalars(self, tel) -> None:
+        """Mirror the driver gauges (pipeline-phase fractions, memory
+        watermarks) into the TrainSummary event file, one scalar per
+        gauge per replayed block — the telemetry view rides alongside
+        Loss/Throughput in TensorBoard."""
+        summary = self.train_summary
+        add = getattr(summary, "add_scalar", None) if summary else None
+        if add is None:
+            return
+        step = self.state["neval"]
+        for name, val in tel.registry.gauges().items():
+            add(f"Telemetry/{name}", float(val), step)
 
     # placement hooks — DistriOptimizer overrides these for sharded /
     # multi-host evaluation; the loop itself lives only here

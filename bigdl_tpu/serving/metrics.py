@@ -7,6 +7,19 @@ through its dashboard (arXiv:2204.01715 §4); the reference
 and surfaces it as a plain-dict snapshot (``service.stats()``) so callers can
 ship it to whatever metrics sink they run.
 
+Since the telemetry PR the backing store is the unified
+:class:`bigdl_tpu.telemetry.registry.MetricRegistry` (counters +
+reservoir histograms) — the same substrate the training driver and the
+runtime watchdogs use.  ``LatencyReservoir`` is the registry
+:class:`~bigdl_tpu.telemetry.registry.Reservoir` (kept under its
+historical name for back-compat).
+
+Latency reservoirs are keyed TWO ways: one global window (the historical
+surface) and one per row-bucket — a 1-row dispatch and a 32-row-bucket
+dispatch have very different service times, and the global p99 hides
+which bucket is paying it (ROADMAP serving item 1c).  Bucket reservoirs
+appear lazily as traffic exercises each bucket.
+
 Everything is host-side bookkeeping — nothing here touches jax.
 """
 
@@ -16,40 +29,10 @@ import threading
 import time
 from typing import Dict, Optional
 
+from bigdl_tpu.telemetry.registry import MetricRegistry, Reservoir
 
-class LatencyReservoir:
-    """Fixed-size ring of recent request latencies (seconds).
-
-    A bounded ring instead of an unbounded list: an always-on endpoint
-    must not grow memory with request count.  Percentiles are computed
-    over the retained window (the most recent ``capacity`` requests),
-    which is the standard sliding-window SLO estimator.
-    """
-
-    def __init__(self, capacity: int = 4096):
-        self._buf = [0.0] * capacity
-        self._n = 0          # total ever recorded
-        self._lock = threading.Lock()
-
-    def record(self, latency_s: float) -> None:
-        with self._lock:
-            self._buf[self._n % len(self._buf)] = latency_s
-            self._n += 1
-
-    def percentiles(self, qs=(50, 95, 99)) -> Optional[Dict[str, float]]:
-        with self._lock:
-            n = min(self._n, len(self._buf))
-            if n == 0:
-                return None
-            window = sorted(self._buf[:n])
-        out = {}
-        for q in qs:
-            # nearest-rank percentile over the window
-            idx = min(n - 1, max(0, int(round(q / 100.0 * n)) - 1))
-            out[f"p{q}"] = window[idx]
-        out["mean"] = sum(window) / n
-        out["max"] = window[-1]
-        return out
+# back-compat alias: the serving latency window IS the registry reservoir
+LatencyReservoir = Reservoir
 
 
 class ServingMetrics:
@@ -60,73 +43,120 @@ class ServingMetrics:
     the batcher is dispatching singletons (no coalescing win).
     """
 
-    def __init__(self):
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        self.registry = registry if registry is not None else MetricRegistry()
         self._lock = threading.Lock()
         self.started_at = time.monotonic()
-        self.submitted = 0
-        self.completed = 0
-        self.rejected = 0
-        self.failed = 0
-        self.cancelled = 0
-        self.dispatches = 0
-        self.rows_real = 0       # rows carrying actual requests
-        self.rows_dispatched = 0  # bucket rows sent to the device
+        reg = self.registry
+        self._submitted = reg.counter("serving/requests_submitted")
+        self._completed = reg.counter("serving/requests_completed")
+        self._rejected = reg.counter("serving/requests_rejected")
+        self._failed = reg.counter("serving/requests_failed")
+        self._cancelled = reg.counter("serving/requests_cancelled")
+        self._dispatches = reg.counter("serving/dispatches")
+        self._rows_real = reg.counter("serving/rows_real")
+        self._rows_dispatched = reg.counter("serving/rows_dispatched")
         self.latency = LatencyReservoir()
+        # per-row-bucket latency windows, created as buckets see traffic
+        self._bucket_latency: Dict[int, Reservoir] = {}
+
+    # back-compat value surface (pre-registry these were plain ints)
+    @property
+    def submitted(self) -> int:
+        return self._submitted.value
+
+    @property
+    def completed(self) -> int:
+        return self._completed.value
+
+    @property
+    def rejected(self) -> int:
+        return self._rejected.value
+
+    @property
+    def failed(self) -> int:
+        return self._failed.value
+
+    @property
+    def cancelled(self) -> int:
+        return self._cancelled.value
+
+    @property
+    def dispatches(self) -> int:
+        return self._dispatches.value
+
+    @property
+    def rows_real(self) -> int:
+        return self._rows_real.value
+
+    @property
+    def rows_dispatched(self) -> int:
+        return self._rows_dispatched.value
 
     # -- recording (called from submit / batcher threads) -----------------
     def record_submit(self, rows: int) -> None:
-        with self._lock:
-            self.submitted += rows
+        self._submitted.inc(rows)
 
     def record_reject(self, rows: int = 1) -> None:
-        with self._lock:
-            self.rejected += rows
+        self._rejected.inc(rows)
 
     def record_dispatch(self, real_rows: int, bucket_rows: int) -> None:
-        with self._lock:
-            self.dispatches += 1
-            self.rows_real += real_rows
-            self.rows_dispatched += bucket_rows
+        self._dispatches.inc()
+        self._rows_real.inc(real_rows)
+        self._rows_dispatched.inc(bucket_rows)
 
-    def record_done(self, rows: int, latency_s: float) -> None:
-        with self._lock:
-            self.completed += rows
+    def record_done(self, rows: int, latency_s: float,
+                    bucket: Optional[int] = None) -> None:
+        self._completed.inc(rows)
         self.latency.record(latency_s)
+        if bucket is not None:
+            res = self._bucket_latency.get(bucket)
+            if res is None:
+                with self._lock:  # lazy get-or-create, race-safe
+                    res = self._bucket_latency.setdefault(
+                        bucket, LatencyReservoir())
+            res.record(latency_s)
 
     def record_failure(self, rows: int) -> None:
-        with self._lock:
-            self.failed += rows
+        self._failed.inc(rows)
 
     def record_cancel(self, rows: int) -> None:
-        with self._lock:
-            self.cancelled += rows
+        self._cancelled.inc(rows)
 
     # -- snapshot ----------------------------------------------------------
+    @staticmethod
+    def _ms(pct: Optional[dict]) -> Optional[dict]:
+        if pct is None:
+            return None
+        return {k: round(v * 1e3, 3) for k, v in pct.items()}
+
     def snapshot(self, queue_depth: int = 0,
                  compile_count: int = 0) -> dict:
         """Plain-dict stats (the ``service.stats()`` schema documented in
         the README serving section).  Latencies are reported in ms."""
+        elapsed = max(time.monotonic() - self.started_at, 1e-9)
+        rows_dispatched = self.rows_dispatched
+        occ = (self.rows_real / rows_dispatched
+               if rows_dispatched else None)
+        snap = {
+            "requests_submitted": self.submitted,
+            "requests_completed": self.completed,
+            "requests_rejected": self.rejected,
+            "requests_failed": self.failed,
+            "requests_cancelled": self.cancelled,
+            "dispatch_count": self.dispatches,
+            "rows_dispatched": rows_dispatched,
+            "mean_batch_occupancy":
+                round(occ, 4) if occ is not None else None,
+            "throughput_rps": round(self.completed / elapsed, 2),
+            "queue_depth": queue_depth,
+            "compile_count": compile_count,
+            "uptime_s": round(elapsed, 3),
+        }
+        snap["latency_ms"] = self._ms(self.latency.percentiles())
         with self._lock:
-            elapsed = max(time.monotonic() - self.started_at, 1e-9)
-            occ = (self.rows_real / self.rows_dispatched
-                   if self.rows_dispatched else None)
-            snap = {
-                "requests_submitted": self.submitted,
-                "requests_completed": self.completed,
-                "requests_rejected": self.rejected,
-                "requests_failed": self.failed,
-                "requests_cancelled": self.cancelled,
-                "dispatch_count": self.dispatches,
-                "rows_dispatched": self.rows_dispatched,
-                "mean_batch_occupancy":
-                    round(occ, 4) if occ is not None else None,
-                "throughput_rps": round(self.completed / elapsed, 2),
-                "queue_depth": queue_depth,
-                "compile_count": compile_count,
-                "uptime_s": round(elapsed, 3),
-            }
-        pct = self.latency.percentiles()
-        snap["latency_ms"] = (
-            {k: round(v * 1e3, 3) for k, v in pct.items()}
-            if pct else None)
+            buckets = sorted(self._bucket_latency.items())
+        snap["latency_ms_by_bucket"] = (
+            {b: self._ms(r.percentiles()) for b, r in buckets}
+            if buckets else None)
         return snap

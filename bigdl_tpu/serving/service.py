@@ -439,7 +439,8 @@ class InferenceService:
                 lo, hi = off, off + r.n_rows
                 r.future.set_result(
                     _tree.tree_map(lambda o: o[lo:hi], out))
-                self.metrics.record_done(r.n_rows, now - r.t_enqueue)
+                self.metrics.record_done(r.n_rows, now - r.t_enqueue,
+                                         bucket=bucket)
                 off = hi
         except Exception as e:  # resolve, never strand, the waiters
             for r in live:

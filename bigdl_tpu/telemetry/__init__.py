@@ -1,0 +1,39 @@
+"""bigdl_tpu.telemetry — tracing, metrics, and runtime watchdogs.
+
+The observability substrate under the training driver and the serving
+engine (ISSUE 6; the foundation BigDL 2.0's cluster pipeline and TVM's
+measurement-driven tuning both stand on):
+
+- :class:`Tracer` — step-timeline spans (host-stack, H2D staging, jit
+  dispatch, device wait, one-block-behind loss fetch, triggers),
+  exported as Chrome-trace JSON; summarize with
+  ``python -m tools.trace_report trace.json``;
+- :class:`MetricRegistry` — counters, gauges, reservoir histograms with
+  p50/p95/p99; ``utils/metrics.Metrics`` and
+  ``serving/metrics.ServingMetrics`` are veneers over it;
+- watchdogs — :class:`RecompileWatchdog` (GL106 discipline at runtime),
+  :class:`StallDetector` (stager starvation / host-sync stalls),
+  :class:`MemoryWatermark` (device allocator gauges where available).
+
+Enable for training via ``Config.telemetry_enabled`` /
+``BIGDL_TPU_TELEMETRY=1`` or per-run with
+``optimizer.set_telemetry(True, trace_path="trace.json")``.
+
+The whole package is host-side: enabling telemetry adds no dispatch, no
+host↔device sync, and leaves the loss sequence bitwise unchanged
+(gated in ``tests/test_telemetry.py``).
+"""
+
+from bigdl_tpu.telemetry.hooks import DriverTelemetry
+from bigdl_tpu.telemetry.registry import (Counter, Gauge, Histogram,
+                                          MetricRegistry, Reservoir)
+from bigdl_tpu.telemetry.tracer import NULL_SPAN, PHASE_CATS, Tracer
+from bigdl_tpu.telemetry.watchdog import (MemoryWatermark,
+                                          RecompileWatchdog, StallDetector,
+                                          jit_cache_size)
+
+__all__ = [
+    "Counter", "DriverTelemetry", "Gauge", "Histogram", "MemoryWatermark",
+    "MetricRegistry", "NULL_SPAN", "PHASE_CATS", "RecompileWatchdog",
+    "Reservoir", "StallDetector", "Tracer", "jit_cache_size",
+]

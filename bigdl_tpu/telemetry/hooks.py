@@ -1,0 +1,60 @@
+"""DriverTelemetry — the bundle the training driver carries.
+
+One object holding the tracer, the metric registry, and the three
+watchdogs, so ``Optimizer._train_driver`` stays readable: every
+telemetry call site in the driver is ``tel.<thing>`` behind a single
+``if tel is not None`` discipline (the driver holds ``None`` when
+telemetry is off — the off path is UNTOUCHED, which is half of the
+inertness proof; the other half is that the on path only reads clocks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu.telemetry.registry import MetricRegistry
+from bigdl_tpu.telemetry.tracer import Tracer
+from bigdl_tpu.telemetry.watchdog import (MemoryWatermark,
+                                          RecompileWatchdog, StallDetector)
+
+
+class DriverTelemetry:
+    """Tracer + registry + watchdogs for one training run.
+
+    ``registry`` defaults to a fresh :class:`MetricRegistry`; the driver
+    passes its ``Metrics`` registry so phase accumulators, watchdog
+    counters, and stall gauges land in ONE snapshot.
+    """
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 trace_capacity: int = 200_000,
+                 trace_path: Optional[str] = None):
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.tracer = Tracer(enabled=True, capacity=trace_capacity)
+        self.recompile = RecompileWatchdog(self.registry, self.tracer)
+        self.stalls = StallDetector(self.registry, self.tracer)
+        self.memory = MemoryWatermark(self.registry)
+        self.trace_path = trace_path
+
+    def snapshot(self) -> dict:
+        """Registry snapshot plus watchdog verdicts — the JSON export."""
+        snap = self.registry.snapshot()
+        snap["watchdogs"] = {
+            "recompile_events": [
+                {"key": str(k), "from": old, "to": new}
+                for k, old, new in self.recompile.events],
+            "stager_starvation_events": self.stalls.starvation_count,
+            "host_sync_stall_events": self.stalls.sync_stall_count,
+            "blocks_observed": self.stalls.blocks_observed,
+            "phase_fractions": self.stalls.fractions(),
+            "memory_stats_available": self.memory.available,
+        }
+        snap["trace"] = {"span_count": len(self.tracer.events()),
+                         "dropped_events": self.tracer.dropped_events}
+        return snap
+
+    def finalize(self) -> Optional[str]:
+        """Dump the Chrome trace if a path was configured."""
+        if self.trace_path:
+            return self.tracer.dump(self.trace_path)
+        return None

@@ -1,0 +1,221 @@
+"""Runtime watchdogs — recompiles, pipeline stalls, memory watermarks.
+
+graftlint catches recompile/host-sync hazards statically (GL106/GL107);
+these watchdogs enforce the same discipline AT RUNTIME, where dynamic
+shapes and data-dependent paths live.  All of them are observers: they
+read cheap host-side state (jit cache sizes, span durations, allocator
+stats), record findings into the :class:`~bigdl_tpu.telemetry.registry.
+MetricRegistry` and the tracer, and log warnings — they never touch the
+computation.
+
+- :class:`RecompileWatchdog` — jit cache-size delta per dispatched
+  block.  The first compile of a key (a new K-block length, a deploy's
+  AOT warmup) is expected and free; any growth AFTER that is a
+  steady-state retrace — the throughput cliff GL106 exists to prevent.
+- :class:`StallDetector` — per-block host-phase accounting.  The driver
+  reports how long each block spent in staging (host-stack + H2D),
+  dispatch enqueue, the one-block-behind device wait, and trigger
+  replay.  Stager starvation = staging dominates while the device wait
+  is ~zero (the device is idle waiting for input).  Host-sync stall =
+  a dispatch enqueue that took milliseconds (issuing an async jit call
+  is microseconds; a blocking enqueue means a hidden host sync or a
+  full device queue).
+- :class:`MemoryWatermark` — ``device.memory_stats()`` gauges where the
+  backend exposes them (TPU does; CPU returns nothing — the gauges just
+  stay absent).  Reading allocator stats is a host call, not a sync.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.telemetry.registry import MetricRegistry
+from bigdl_tpu.telemetry.tracer import Tracer
+
+logger = logging.getLogger("bigdl_tpu.telemetry")
+
+
+def jit_cache_size(fn) -> Optional[int]:
+    """Compiled-signature count of a ``jax.jit`` wrapper (None when the
+    object isn't a jit wrapper or the internal moved)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+class RecompileWatchdog:
+    """Flags jit cache growth after a key's first observation.
+
+    ``observe(key, cache_size)`` per dispatched block (or per serving
+    traffic window): the first observation of a key records its
+    baseline (the planned compile); any later growth is a steady-state
+    recompile — counted, traced as an instant event, and warned once
+    per occurrence.  ``observe`` with ``cache_size=None`` is a no-op,
+    so call sites never need to branch on backend capabilities.
+    """
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 tracer: Optional[Tracer] = None):
+        self._seen: Dict[object, int] = {}
+        self.events: List[Tuple[object, int, int]] = []  # (key, old, new)
+        self._counter = (registry.counter("telemetry/recompiles")
+                         if registry is not None else None)
+        self._tracer = tracer
+
+    def observe(self, key, cache_size: Optional[int]) -> bool:
+        """Returns True when this observation flagged a recompile."""
+        if cache_size is None:
+            return False
+        prev = self._seen.get(key)
+        self._seen[key] = cache_size
+        if prev is None or cache_size <= prev:
+            return False
+        self.events.append((key, prev, cache_size))
+        if self._counter is not None:
+            self._counter.inc()
+        if self._tracer is not None:
+            self._tracer.instant("recompile", key=str(key),
+                                 cache_size=cache_size)
+        logger.warning(
+            "recompile watchdog: jit cache for %r grew %d -> %d after "
+            "warmup — a steady-state retrace (GL106 discipline; check "
+            "for shape churn / per-call scalar args)", key, prev,
+            cache_size)
+        return True
+
+    @property
+    def recompile_count(self) -> int:
+        return len(self.events)
+
+    @property
+    def silent(self) -> bool:
+        """No steady-state recompile observed."""
+        return not self.events
+
+
+class StallDetector:
+    """Per-block pipeline-phase accounting + stall/starvation flags.
+
+    ``record_block`` takes the four host-accounted phase durations of
+    one dispatched block.  Fractions are of the host-accounted total
+    (stage + dispatch + wait + replay) — device compute hidden behind
+    the pipeline is deliberately not in the denominator; a healthy
+    pipelined run shows ``device_wait`` absorbing nearly everything.
+    """
+
+    def __init__(self, registry: MetricRegistry,
+                 tracer: Optional[Tracer] = None,
+                 starvation_threshold: float = 0.5,
+                 wait_floor: float = 0.1,
+                 dispatch_stall_ms: float = 50.0,
+                 warm_blocks: int = 1):
+        self._registry = registry
+        self._tracer = tracer
+        self.starvation_threshold = starvation_threshold
+        self.wait_floor = wait_floor
+        self.dispatch_stall_ms = dispatch_stall_ms
+        self.warm_blocks = warm_blocks
+        self._totals = {"stage": 0.0, "dispatch": 0.0,
+                        "device_wait": 0.0, "replay": 0.0}
+        self._blocks = 0
+        self._starvations = registry.counter(
+            "telemetry/stager_starvation_events")
+        self._sync_stalls = registry.counter(
+            "telemetry/host_sync_stall_events")
+
+    def record_block(self, stage_s: float, dispatch_s: float,
+                     wait_s: float, replay_s: float,
+                     first_compile: bool = False) -> None:
+        """``first_compile``: this block's dispatch traced+compiled a
+        fresh jit signature — a planned one-off cost, charged to the
+        fractions but exempt from the stall flags (compile time is not
+        a steady-state host sync)."""
+        self._blocks += 1
+        t = self._totals
+        t["stage"] += stage_s
+        t["dispatch"] += dispatch_s
+        t["device_wait"] += wait_s
+        t["replay"] += replay_s
+        fr = self.fractions()
+        reg = self._registry
+        reg.gauge("driver/host_stage_fraction").set(fr["stage"])
+        reg.gauge("driver/dispatch_fraction").set(fr["dispatch"])
+        reg.gauge("driver/device_wait_fraction").set(fr["device_wait"])
+        reg.gauge("driver/replay_fraction").set(fr["replay"])
+        if first_compile or self._blocks <= self.warm_blocks:
+            # warmup blocks carry compile/allocator noise — fractions
+            # recorded, verdicts withheld (the bench warmup discipline)
+            return
+        block_total = stage_s + dispatch_s + wait_s + replay_s
+        if block_total > 0:
+            if (stage_s / block_total > self.starvation_threshold
+                    and wait_s / block_total < self.wait_floor):
+                self._starvations.inc()
+                if self._tracer is not None:
+                    self._tracer.instant(
+                        "stager_starvation",
+                        stage_ms=round(stage_s * 1e3, 3),
+                        wait_ms=round(wait_s * 1e3, 3))
+        if dispatch_s * 1e3 > self.dispatch_stall_ms:
+            self._sync_stalls.inc()
+            if self._tracer is not None:
+                self._tracer.instant(
+                    "host_sync_stall",
+                    dispatch_ms=round(dispatch_s * 1e3, 3))
+            logger.warning(
+                "stall detector: block dispatch enqueue took %.1f ms "
+                "(budget %.1f ms) — a hidden host sync or a saturated "
+                "device queue is blocking the driver loop",
+                dispatch_s * 1e3, self.dispatch_stall_ms)
+
+    def fractions(self) -> Dict[str, float]:
+        total = sum(self._totals.values())
+        if total <= 0:
+            return {k: 0.0 for k in self._totals}
+        return {k: v / total for k, v in self._totals.items()}
+
+    @property
+    def blocks_observed(self) -> int:
+        return self._blocks
+
+    @property
+    def starvation_count(self) -> int:
+        return self._starvations.value
+
+    @property
+    def sync_stall_count(self) -> int:
+        return self._sync_stalls.value
+
+
+class MemoryWatermark:
+    """Device-memory gauges from ``device.memory_stats()``.
+
+    TPU runtimes expose ``bytes_in_use`` / ``peak_bytes_in_use``; the
+    CPU backend exposes nothing — ``observe`` then returns None and no
+    gauges appear.  Reading allocator counters never syncs the device.
+    """
+
+    _KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+    def __init__(self, registry: MetricRegistry):
+        self._registry = registry
+        self.available: Optional[bool] = None  # unknown until first observe
+
+    def observe(self, device=None) -> Optional[dict]:
+        if device is None:
+            import jax
+            device = jax.local_devices()[0]
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            self.available = False
+            return None
+        self.available = True
+        for k in self._KEYS:
+            if k in stats:
+                self._registry.gauge(f"device/{k}").set(stats[k])
+        return stats

@@ -70,6 +70,17 @@ class Config:
     # logging / observability
     log_every_n_iterations: int = 1
     summary_flush_secs: float = 10.0
+    # telemetry (bigdl_tpu/telemetry): step-timeline tracer + metric
+    # registry + runtime watchdogs wired through the training driver.
+    # Provably inert — enabling adds no dispatch and no host sync; the
+    # loss sequence is bitwise identical (tests/test_telemetry.py).
+    # BIGDL_TPU_TELEMETRY=1 is the short env alias for
+    # BIGDL_TPU_TELEMETRY_ENABLED=1.  telemetry_trace_path: write the
+    # Chrome-trace JSON there when training ends ("" = keep in memory;
+    # summarize with `python -m tools.trace_report <path>`).
+    telemetry_enabled: bool = False
+    telemetry_trace_path: str = ""
+    telemetry_trace_capacity: int = 200_000  # retained spans, then drop+count
     # mesh defaults (dryrun/tests override explicitly)
     mesh_data: int = -1
     mesh_model: int = 1
@@ -91,6 +102,12 @@ class Config:
                 setattr(cfg, f.name,
                         cls._coerce(os.environ[env], type(getattr(cfg,
                                                                   f.name))))
+        # short alias: BIGDL_TPU_TELEMETRY=1 ⇔ BIGDL_TPU_TELEMETRY_ENABLED=1
+        # (the explicit long form wins when both are set)
+        alias = _ENV_PREFIX + "TELEMETRY"
+        if alias in os.environ and \
+                _ENV_PREFIX + "TELEMETRY_ENABLED" not in os.environ:
+            cfg.telemetry_enabled = cls._coerce(os.environ[alias], bool)
         return cfg
 
 

@@ -1,26 +1,39 @@
-"""Metrics — named training-loop phase counters.
+"""Metrics — named training-loop phase accumulators.
 
 Reference: ``DL/optim/Metrics.scala:31`` — named counters backed by Spark
 accumulators, printed by ``summary()``; the built-in profiling of the
-training loop.  Here: plain host-side aggregation (one process per host;
-cross-host aggregation would ride jax collectives if ever needed).
+training loop.
+
+Since the telemetry PR this is a thin veneer over
+:class:`bigdl_tpu.telemetry.registry.MetricRegistry` — the driver's
+phase accumulators, the serving engine's counters, and the runtime
+watchdogs share ONE metrics implementation (each named accumulator is a
+registry :class:`~bigdl_tpu.telemetry.registry.Histogram`, so the same
+data also carries p50/p95/p99 for free).  The public surface —
+``add``/``time``/``value``/``mean``/``summary``/``reset`` — and the
+``summary()`` string format are unchanged (back-compat gated in
+``tests/test_telemetry.py``).
 """
 
 from __future__ import annotations
 
 import time
-from collections import defaultdict
 from contextlib import contextmanager
+from typing import Optional
+
+from bigdl_tpu.telemetry.registry import MetricRegistry
 
 
 class Metrics:
-    def __init__(self):
-        self._sums = defaultdict(float)
-        self._counts = defaultdict(int)
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        # shared registry (the driver hands its telemetry registry in)
+        # or a private one — either way the veneer below is identical
+        self.registry = registry if registry is not None else MetricRegistry()
+        self._owned: set = set()  # names this instance created
 
     def add(self, name: str, value: float) -> None:
-        self._sums[name] += value
-        self._counts[name] += 1
+        self._owned.add(name)
+        self.registry.histogram(name).observe(value)
 
     @contextmanager
     def time(self, name: str):
@@ -30,20 +43,40 @@ class Metrics:
         finally:
             self.add(name, time.perf_counter() - t0)
 
+    def _hist(self, name: str):
+        m = self.registry.get(name)
+        from bigdl_tpu.telemetry.registry import Histogram
+        return m if isinstance(m, Histogram) else None
+
     def value(self, name: str) -> float:
-        return self._sums[name]
+        h = self._hist(name)
+        return h.sum if h is not None else 0.0
 
     def mean(self, name: str) -> float:
-        c = self._counts[name]
-        return self._sums[name] / c if c else 0.0
+        h = self._hist(name)
+        return h.mean if h is not None else 0.0
 
     def summary(self) -> str:
         """(reference ``Metrics.summary`` printed at
         ``DistriOptimizer.scala:393``)"""
-        parts = [f"{k}: sum={self._sums[k]:.4f} mean={self.mean(k):.4f} "
-                 f"n={self._counts[k]}" for k in sorted(self._sums)]
+        from bigdl_tpu.telemetry.registry import Histogram
+        rows = [(name, m) for name in self.registry.names()
+                for m in [self.registry.get(name)]
+                if isinstance(m, Histogram)]
+        parts = [f"{k}: sum={h.sum:.4f} mean={h.mean:.4f} n={h.count}"
+                 for k, h in rows]
         return "\n".join(parts)
 
+    def snapshot(self) -> dict:
+        """JSON-able registry snapshot (superset of ``summary()``)."""
+        return self.registry.snapshot()
+
     def reset(self) -> None:
-        self._sums.clear()
-        self._counts.clear()
+        """Clear THIS instance's accumulators only.  The registry may be
+        shared with the telemetry watchdogs (gauges + cached counter
+        objects); a blanket ``registry.reset()`` would orphan those —
+        their later increments would update objects no snapshot can see
+        — so only the names this Metrics created are discarded."""
+        for name in self._owned:
+            self.registry.discard(name)
+        self._owned.clear()

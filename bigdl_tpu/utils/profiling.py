@@ -105,14 +105,32 @@ def format_times(times: List[LayerTime]) -> str:
     return "\n".join(lines)
 
 
-def profile_step(step_fn, *args, log_dir: str, steps: int = 3):
+def profile_step(step_fn, *args, log_dir: str, steps: int = 3,
+                 tracer=None):
     """Run ``step_fn(*args)`` under the jax profiler (xplane trace in
     ``log_dir``; open with TensorBoard).  The jit'd step's per-op times
-    carry the layer names annotated by jit tracing."""
+    carry the layer names annotated by jit tracing.
+
+    ``tracer``: optional :class:`bigdl_tpu.telemetry.Tracer` bridge —
+    the profiled region and each profiled step also land as spans in
+    the telemetry Chrome trace, so the step timeline links to the
+    xplane capture (the span's ``log_dir`` arg is the pointer).  The
+    deliberate divergence from the driver's inertness rule: this
+    function exists to sync (``block_until_ready`` per step) — it is
+    the opt-in, off-the-hot-path deep dive, never the always-on path.
+    """
+    from contextlib import nullcontext
+
+    def span(name, **kw):
+        return tracer.span(name, cat="profiler", **kw) if tracer \
+            else nullcontext()
+
     # warmup/compile outside the trace
     _block(step_fn(*args))
-    with jax.profiler.trace(log_dir):
-        out = None
-        for _ in range(steps):
-            out = _block(step_fn(*args))
+    with span("jax_profiler_trace", log_dir=log_dir, steps=steps):
+        with jax.profiler.trace(log_dir):
+            out = None
+            for i in range(steps):
+                with span("profiled_step", i=i):
+                    out = _block(step_fn(*args))
     return out

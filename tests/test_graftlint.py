@@ -692,6 +692,18 @@ class TestRealTree:
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.errors == [], msgs
 
+    def test_telemetry_package_lints_clean(self):
+        """The telemetry package rides inside the bigdl_tpu gate above,
+        but its inertness contract (host-side only — no jit-reachable
+        syncs, no tensor branches) earns an explicit standalone gate:
+        a regression here means telemetry code leaked into traced
+        scope."""
+        result = lint_paths([os.path.join(REPO, "bigdl_tpu",
+                                          "telemetry")])
+        assert result.files_scanned >= 5
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))

@@ -419,12 +419,17 @@ class TestServiceSurface:
         for key in ("requests_submitted", "requests_completed",
                     "dispatch_count", "mean_batch_occupancy",
                     "throughput_rps", "queue_depth", "latency_ms",
-                    "compile_count", "buckets", "model"):
+                    "latency_ms_by_bucket", "compile_count", "buckets",
+                    "model"):
             assert key in s, key
         assert s["latency_ms"] is not None
         assert {"p50", "p95", "p99", "mean"} <= set(s["latency_ms"])
         assert s["latency_ms"]["p50"] <= s["latency_ms"]["p95"] \
             <= s["latency_ms"]["p99"]
+        # per-row-bucket reservoirs (telemetry PR): the 3-row request
+        # dispatched into the 4-bucket; only exercised buckets appear
+        assert set(s["latency_ms_by_bucket"]) == {4}
+        assert {"p50", "p95", "p99"} <= set(s["latency_ms_by_bucket"][4])
         assert 0 < s["mean_batch_occupancy"] <= 1.0
         assert s["throughput_rps"] > 0
         svc.stop()

@@ -1,0 +1,197 @@
+"""trace_report — summarize a bigdl_tpu telemetry Chrome trace.
+
+Reads the Chrome-trace JSON the telemetry tracer emits
+(``Tracer.dump`` / ``Config.telemetry_trace_path``) and prints the
+driver-pipeline picture the raw timeline buries:
+
+- **per-phase time share** — self-time per span category (stage /
+  dispatch / device_wait / replay / trigger) over the trace wall clock,
+  plus ``other`` for unaccounted time, summing to ~1.  Self-time:
+  nested spans (a validation span inside a replay span) are charged to
+  the child, never double-counted;
+- **top spans** — by total duration, with call counts and mean;
+- **stall picture** — device-wait fraction (host blocked on device —
+  healthy when the device is the bottleneck) vs host-stage fraction
+  (device starved by the input pipeline);
+- **watchdog events** — recompiles, stager starvations, host-sync
+  stalls (instant events the watchdogs injected).
+
+Usage::
+
+    python -m tools.trace_report trace.json
+    python -m tools.trace_report trace.json --json
+    python -m tools.trace_report trace.json --top 20
+
+Virtual tracks (the ``device`` track carrying in-flight block spans,
+category ``pipeline``) overlap the host timeline by design and are
+excluded from phase-share accounting — they answer "what was the device
+doing", not "where did host time go".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+# categories counted as host pipeline phases; spans on virtual tracks
+# (cat "pipeline") overlap the host timeline and are excluded
+PHASE_CATS = ("stage", "dispatch", "device_wait", "replay", "trigger")
+_EXCLUDED_CATS = {"pipeline"}
+
+
+def load_trace(path: str) -> dict:
+    """Load a Chrome-trace JSON file; accepts both the object form
+    (``{"traceEvents": [...]}``) and a bare event list."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        data = {"traceEvents": data}
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(
+            f"{path}: not a Chrome trace (no traceEvents key)")
+    return data
+
+
+def _self_times(spans: List[dict]) -> Dict[int, float]:
+    """Self time (dur minus nested-child dur) per span index, computed
+    per tid with a nesting stack.  Spans from ``with`` blocks on one
+    thread nest properly; partial overlap (malformed input) is treated
+    as nested-by-start-order, which only redistributes time between the
+    overlapping pair."""
+    self_us = {i: float(s.get("dur", 0.0)) for i, s in enumerate(spans)}
+    by_tid = defaultdict(list)
+    for i, s in enumerate(spans):
+        by_tid[s.get("tid", 0)].append(i)
+    for tid, idxs in by_tid.items():
+        idxs.sort(key=lambda i: (spans[i]["ts"], -spans[i].get("dur", 0.0)))
+        stack: List[int] = []  # indices of currently-open spans
+        for i in idxs:
+            ts = spans[i]["ts"]
+            while stack and spans[stack[-1]]["ts"] \
+                    + spans[stack[-1]].get("dur", 0.0) <= ts:
+                stack.pop()
+            if stack:  # nested: charge my duration against the parent
+                self_us[stack[-1]] -= spans[i].get("dur", 0.0)
+            stack.append(i)
+    return self_us
+
+
+def summarize(trace: dict, top: int = 10) -> dict:
+    """Aggregate a loaded trace into the report dict (the schema the
+    fixture test gates)."""
+    events = trace["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    host_spans = [s for s in spans
+                  if s.get("cat") not in _EXCLUDED_CATS]
+    if not spans:
+        raise ValueError("trace contains no complete ('X') spans")
+    t0 = min(s["ts"] for s in spans)
+    t1 = max(s["ts"] + s.get("dur", 0.0) for s in spans)
+    wall_us = max(t1 - t0, 1e-9)
+
+    self_us = _self_times(host_spans)
+    cat_us: Dict[str, float] = defaultdict(float)
+    name_rows: Dict[str, dict] = {}
+    for i, s in enumerate(host_spans):
+        cat = s.get("cat") or "uncategorized"
+        cat_us[cat] += self_us[i]
+        row = name_rows.setdefault(
+            s["name"], {"name": s["name"], "cat": cat, "count": 0,
+                        "total_us": 0.0})
+        row["count"] += 1
+        row["total_us"] += s.get("dur", 0.0)
+
+    share = {c: round(cat_us.get(c, 0.0) / wall_us, 4)
+             for c in sorted(cat_us)}
+    accounted = sum(share.values())
+    share["other"] = round(max(0.0, 1.0 - accounted), 4)
+
+    top_spans = sorted(name_rows.values(),
+                       key=lambda r: -r["total_us"])[:top]
+    for r in top_spans:
+        r["total_ms"] = round(r.pop("total_us") / 1e3, 3)
+        r["mean_ms"] = round(r["total_ms"] / r["count"], 4)
+
+    watchdog = defaultdict(int)
+    recompiles = []
+    for e in instants:
+        watchdog[e["name"]] += 1
+        if e["name"] == "recompile":
+            recompiles.append(e.get("args", {}))
+
+    other = trace.get("otherData", {})
+    return {
+        "wall_s": round(wall_us / 1e6, 6),
+        "span_count": len(spans),
+        "dropped_events": other.get("dropped_events", 0),
+        "phase_share": share,
+        "phase_seconds": {c: round(v / 1e6, 6)
+                          for c, v in sorted(cat_us.items())},
+        "stall": {
+            "device_wait_fraction": share.get("device_wait", 0.0),
+            "host_stage_fraction": share.get("stage", 0.0),
+            "dispatch_fraction": share.get("dispatch", 0.0),
+        },
+        "recompile_events": recompiles,
+        "watchdog_events": dict(watchdog),
+        "top_spans": top_spans,
+    }
+
+
+def _render(report: dict) -> str:
+    lines = [f"wall {report['wall_s'] * 1e3:.1f} ms, "
+             f"{report['span_count']} spans"
+             + (f" ({report['dropped_events']} dropped)"
+                if report["dropped_events"] else "")]
+    lines.append("phase share (self-time / wall):")
+    for cat, frac in sorted(report["phase_share"].items(),
+                            key=lambda kv: -kv[1]):
+        lines.append(f"  {cat:<14} {frac * 100:6.2f}%")
+    st = report["stall"]
+    lines.append(
+        f"stall picture: device_wait {st['device_wait_fraction']:.3f} "
+        f"(host blocked on device), host_stage "
+        f"{st['host_stage_fraction']:.3f} (device starved by input)")
+    if report["watchdog_events"]:
+        lines.append("watchdog events: " + ", ".join(
+            f"{k}×{v}" for k, v in sorted(
+                report["watchdog_events"].items())))
+        for r in report["recompile_events"]:
+            lines.append(f"  recompile: {r}")
+    else:
+        lines.append("watchdog events: none")
+    lines.append(f"top spans:")
+    w = max((len(r["name"]) for r in report["top_spans"]), default=8)
+    lines.append(f"  {'span':<{w}}  {'count':>6}  {'total(ms)':>10}  "
+                 f"{'mean(ms)':>9}")
+    for r in report["top_spans"]:
+        lines.append(f"  {r['name']:<{w}}  {r['count']:>6}  "
+                     f"{r['total_ms']:>10.3f}  {r['mean_ms']:>9.4f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.trace_report",
+        description="Summarize a bigdl_tpu telemetry Chrome trace")
+    p.add_argument("trace", help="Chrome-trace JSON file (Tracer.dump)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the report as JSON")
+    p.add_argument("--top", type=int, default=10,
+                   help="how many top spans to show")
+    args = p.parse_args(argv)
+    try:
+        report = summarize(load_trace(args.trace), top=args.top)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace_report: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report) if args.as_json else _render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
