@@ -24,6 +24,7 @@ from bigdl_tpu import optim
 from bigdl_tpu import dataset
 from bigdl_tpu import parallel
 from bigdl_tpu import models
+from bigdl_tpu import checkpoint
 from bigdl_tpu import serving
 from bigdl_tpu import telemetry
 from bigdl_tpu import utils

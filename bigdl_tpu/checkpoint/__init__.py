@@ -1,0 +1,39 @@
+"""bigdl_tpu.checkpoint — async, fault-tolerant checkpointing.
+
+The TPU-native replacement for the reference's ``setCheckpoint`` +
+retry-from-``model.N`` story (``DistriOptimizer.scala:505-531,
+981-1061``):
+
+- :mod:`~bigdl_tpu.checkpoint.snapshot` — atomic (tmp + fsync +
+  rename), checksummed (per-array CRC32c manifest), data-only ``.npz``
+  snapshots + the bounded background :class:`AsyncSnapshotWriter`;
+- :mod:`~bigdl_tpu.checkpoint.manager` — :class:`CheckpointManager`:
+  retention (``keep_last`` + ``keep_every``), latest-VALID discovery
+  that skips torn/corrupt snapshots, full-training-state save and
+  mid-epoch-EXACT ``restore_into``;
+- :mod:`~bigdl_tpu.checkpoint.schema` — manifest schema build/diff so
+  resume across grad_sync / bucket-plan / architecture drift fails
+  loudly;
+- :mod:`~bigdl_tpu.checkpoint.preemption` — SIGTERM/SIGINT →
+  finish-block + final-snapshot + clean exit.
+
+Inspect any snapshot without loading it:
+``python -m tools.ckpt_inspect <dir-or-file>``.
+"""
+
+from bigdl_tpu.checkpoint.manager import CheckpointManager
+from bigdl_tpu.checkpoint.preemption import PreemptionHandler
+from bigdl_tpu.checkpoint.schema import (SchemaMismatchError, build_schema,
+                                         diff_schemas, schema_hash,
+                                         validate_schema)
+from bigdl_tpu.checkpoint.snapshot import (AsyncSnapshotWriter,
+                                           SnapshotError, capture_to_host,
+                                           load_snapshot, read_manifest,
+                                           verify_snapshot, write_snapshot)
+
+__all__ = [
+    "CheckpointManager", "PreemptionHandler", "AsyncSnapshotWriter",
+    "SnapshotError", "SchemaMismatchError", "build_schema", "diff_schemas",
+    "schema_hash", "validate_schema", "capture_to_host", "load_snapshot",
+    "read_manifest", "verify_snapshot", "write_snapshot",
+]

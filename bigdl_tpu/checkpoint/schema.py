@@ -1,0 +1,122 @@
+"""Training-state schema: build, hash, and diff-validate on resume.
+
+PR 4 taught the resume path to reject an opt_state written by the other
+gradient-sync mode (``DistriOptimizer._check_resumed_opt_state``) by
+sniffing the pytree shape.  This module generalizes that to the FULL
+manifest: a snapshot records a structured description of the training
+state it holds — parameter tree (shapes/dtypes), gradient-sync
+configuration (enabled, bucket plan, wire dtype, shard count), and the
+optimizer method — and resume compares it field-by-field against the
+current run.  Any drift (grad_sync flipped, ``grad_bucket_bytes``
+changed, a layer resized) fails LOUDLY with a diff-style message
+instead of an opaque jit structure error three layers down.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import List, Optional
+
+
+class SchemaMismatchError(ValueError):
+    """Resume state does not match the snapshot's schema."""
+
+
+def describe_params(params) -> dict:
+    """Param pytree → ``{leaf path: "shape:dtype"}`` (the architecture
+    fingerprint; path strings come from ``jax.tree_util.keystr``)."""
+    import jax
+    import numpy as np
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = {}
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        # .shape first: leaves may be ShapeDtypeStructs (eval_shape
+        # fingerprints) that np.shape cannot coerce
+        shape = getattr(leaf, "shape", None)
+        shape = tuple(np.shape(leaf) if shape is None else shape)
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        out[key] = f"{shape}:{dtype}"
+    return out
+
+
+def build_schema(params, *, grad_sync: bool = False,
+                 bucket_sizes: Optional[List[int]] = None,
+                 wire_dtype: Optional[str] = None,
+                 n_shard: Optional[int] = None,
+                 optim_method: Optional[str] = None) -> dict:
+    """The schema dict a snapshot manifest carries (JSON-able)."""
+    gs: dict = {"enabled": bool(grad_sync)}
+    if grad_sync:
+        gs.update(bucket_sizes=[int(s) for s in (bucket_sizes or [])],
+                  wire_dtype=str(wire_dtype), n_shard=int(n_shard or 1))
+    return {
+        "params": describe_params(params),
+        "grad_sync": gs,
+        "optim_method": optim_method,
+    }
+
+
+def schema_hash(schema: dict) -> str:
+    """Stable short hash of the canonical JSON form (manifest display +
+    quick equality)."""
+    blob = json.dumps(schema, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+def _diff_section(lines: List[str], label: str, saved, current) -> None:
+    if saved != current:
+        lines.append(f"  {label}:")
+        lines.append(f"    - snapshot: {saved}")
+        lines.append(f"    + current:  {current}")
+
+
+def diff_schemas(saved: dict, current: dict) -> List[str]:
+    """Human-readable diff lines (empty = compatible)."""
+    lines: List[str] = []
+    _diff_section(lines, "optim_method", saved.get("optim_method"),
+                  current.get("optim_method"))
+    sgs, cgs = saved.get("grad_sync") or {}, current.get("grad_sync") or {}
+    if bool(sgs.get("enabled")) != bool(cgs.get("enabled")):
+        _diff_section(lines, "grad_sync.enabled", sgs.get("enabled"),
+                      cgs.get("enabled"))
+    elif sgs.get("enabled"):
+        for k in ("bucket_sizes", "wire_dtype", "n_shard"):
+            _diff_section(lines, f"grad_sync.{k}", sgs.get(k), cgs.get(k))
+    sp, cp = saved.get("params") or {}, current.get("params") or {}
+    for key in sorted(set(sp) | set(cp)):
+        _diff_section(lines, f"params{key}", sp.get(key, "<absent>"),
+                      cp.get(key, "<absent>"))
+    return lines
+
+
+def validate_schema(saved: Optional[dict], current: dict,
+                    source: str = "checkpoint") -> None:
+    """Raise :class:`SchemaMismatchError` with the full diff when the
+    snapshot's schema and the current run's disagree.  ``saved=None``
+    (a legacy pre-manifest snapshot) validates nothing — the structural
+    fallback checks in ``DistriOptimizer._check_resumed_opt_state``
+    still apply."""
+    if saved is None:
+        return
+    lines = diff_schemas(saved, current)
+    if not lines:
+        return
+    hints = []
+    sgs, cgs = (saved.get("grad_sync") or {}), \
+        (current.get("grad_sync") or {})
+    if bool(sgs.get("enabled")) != bool(cgs.get("enabled")):
+        hints.append("resume with the matching grad_sync / "
+                     "parameter_sharding setting")
+    elif sgs.get("enabled") and sgs != cgs:
+        hints.append("the bucket plan drifted — restore the original "
+                     "mesh size / grad_bucket_bytes / grad_wire_dtype")
+    if (saved.get("params") or {}) != (current.get("params") or {}):
+        hints.append("the model architecture changed since the "
+                     "snapshot was written")
+    hints.append("or clear the checkpoint directory to start fresh")
+    raise SchemaMismatchError(
+        f"{source} schema mismatch — refusing to resume (the saved "
+        "state would be silently reinterpreted):\n"
+        + "\n".join(lines) + "\nhint: " + "; ".join(hints))

@@ -40,6 +40,19 @@ class AbstractDataSet:
     def shuffle(self) -> None:
         raise NotImplementedError
 
+    # -- checkpoint/resume position (bigdl_tpu.checkpoint) --------------
+    # The shuffle order must be reconstructible from a small JSON dict
+    # for mid-epoch-exact resume: a restored run re-derives the SAME
+    # permutation the interrupted run was consuming, and the driver's
+    # records_processed fast-forward lands on the exact next batch.
+    def position_state(self) -> dict:
+        """JSON-able shuffle/stream position for a checkpoint manifest
+        (empty when this dataset has no shuffle state)."""
+        return {}
+
+    def restore_position(self, state: dict) -> None:
+        """Re-derive the shuffle order saved by :meth:`position_state`."""
+
     def transform(self, transformer: Transformer) -> "TransformedDataSet":
         return TransformedDataSet(self, transformer)
 
@@ -54,14 +67,33 @@ class LocalDataSet(AbstractDataSet):
 
     def __init__(self, data: Sequence, seed: int = 1):
         self._data = data
-        self._rng = np.random.default_rng(seed)
+        self._seed = seed
+        self._epoch = 0  # shuffles so far; epoch 0 = insertion order
         self._indexes = np.arange(len(data))
 
     def size(self) -> int:
         return len(self._data)
 
+    def _permutation(self, epoch: int) -> np.ndarray:
+        # epoch-KEYED permutation (not a sequentially-advanced rng): the
+        # order of epoch E is a pure function of (seed, E), so a resumed
+        # run re-derives it without replaying E-1 earlier shuffles —
+        # the mid-epoch-exact-resume contract of bigdl_tpu.checkpoint
+        if epoch == 0:
+            return np.arange(len(self._data))
+        return np.random.default_rng(
+            (self._seed, epoch)).permutation(len(self._data))
+
     def shuffle(self) -> None:
-        self._rng.shuffle(self._indexes)
+        self._epoch += 1
+        self._indexes = self._permutation(self._epoch)
+
+    def position_state(self) -> dict:
+        return {"shuffle_epoch": self._epoch}
+
+    def restore_position(self, state: dict) -> None:
+        self._epoch = int(state.get("shuffle_epoch", 0))
+        self._indexes = self._permutation(self._epoch)
 
     def data(self, train: bool) -> Iterator:
         if train:
@@ -98,10 +130,24 @@ class DistributedDataSet(AbstractDataSet):
     def local_size(self) -> int:
         return len(range(self._p, len(self._data), self._np))
 
+    def _permutation(self) -> np.ndarray:
+        if self._epoch == 0:
+            return np.arange(len(self._data))
+        return np.random.default_rng(
+            self._seed + self._epoch).permutation(len(self._data))
+
     def shuffle(self) -> None:
         self._epoch += 1
-        rng = np.random.default_rng(self._seed + self._epoch)
-        self._global_indexes = rng.permutation(len(self._data))
+        self._global_indexes = self._permutation()
+
+    def position_state(self) -> dict:
+        return {"shuffle_epoch": self._epoch}
+
+    def restore_position(self, state: dict) -> None:
+        # already epoch-keyed (all hosts permute with the same seed) —
+        # restoring is just re-deriving the permutation for that epoch
+        self._epoch = int(state.get("shuffle_epoch", 0))
+        self._global_indexes = self._permutation()
 
     def data(self, train: bool) -> Iterator:
         local = self._global_indexes[self._p::self._np]
@@ -130,6 +176,15 @@ class TransformedDataSet(AbstractDataSet):
 
     def shuffle(self) -> None:
         self.base.shuffle()
+
+    def position_state(self) -> dict:
+        fn = getattr(self.base, "position_state", None)
+        return fn() if fn is not None else {}
+
+    def restore_position(self, state: dict) -> None:
+        fn = getattr(self.base, "restore_position", None)
+        if fn is not None:
+            fn(state)
 
     def data(self, train: bool) -> Iterator:
         return self.transformer(self.base.data(train))

@@ -54,10 +54,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu.checkpoint import build_schema
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.parallel import grad_sync
-from bigdl_tpu.utils.checkpoint import latest_checkpoint, load_checkpoint
 from bigdl_tpu.utils.config import get_config
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -371,10 +371,8 @@ class DistriOptimizer(Optimizer):
         from jax.experimental import multihost_utils
         return multihost_utils.process_allgather(arr, tiled=True)
 
-    def _maybe_checkpoint(self, params, mstate, ostate):
-        if not (self.checkpoint_trigger and self.checkpoint_path
-                and self.checkpoint_trigger(self.state)):
-            return
+    def _do_checkpoint(self, params, mstate, ostate,
+                       sync: bool = False) -> None:
         if jax.process_count() > 1:
             # sharded leaves are not fully addressable on one process:
             # allgather to host, then only process 0 writes
@@ -382,8 +380,25 @@ class DistriOptimizer(Optimizer):
             mstate = tmap(self._host_global, mstate)
             ostate = tmap(self._host_global, ostate)
             if jax.process_index() != 0:
+                # record the step on EVERY process: the preemption
+                # branch's already-saved dedup reads last_saved_step,
+                # and a process-0-only update would make that predicate
+                # diverge — non-zero hosts would enter the allgather
+                # above while process 0 skips it (collective deadlock)
+                self._checkpoint_manager().last_saved_step = \
+                    int(self.state["neval"])
                 return
-        super()._maybe_checkpoint(params, mstate, ostate)
+        super()._do_checkpoint(params, mstate, ostate, sync=sync)
+
+    def _checkpoint_schema(self, params) -> dict:
+        if not self._use_grad_sync:
+            return super()._checkpoint_schema(params)
+        return build_schema(
+            params, grad_sync=True,
+            bucket_sizes=self._gs_plan.bucket_sizes,
+            wire_dtype=jnp.dtype(self._gs_wire).name,
+            n_shard=self._gs_plan.n_shard,
+            optim_method=type(self.optim_method).__name__)
 
     # ------------------------------------------------------------- train
     def optimize(self):
@@ -393,26 +408,27 @@ class DistriOptimizer(Optimizer):
                 return self._optimize_impl()
             except Exception:
                 # reference retry-from-checkpoint loop
-                # (DistriOptimizer.scala:981-1061)
+                # (DistriOptimizer.scala:981-1061), now on the manager:
+                # discovery returns the latest VALID snapshot (a torn/
+                # corrupt file from the crash window is skipped, never
+                # loaded) and restore_into brings back the FULL state —
+                # params, model state, optimizer state (Adam moments /
+                # grad_sync masters; schema-validated in the next
+                # _optimize_impl), driver counters, RNG seed and the
+                # dataset shuffle position, so the retried run replays
+                # the interrupted one exactly
                 attempts += 1
                 if attempts > self.failure_retry_times \
                         or not self.checkpoint_path:
                     raise
-                ckpt = latest_checkpoint(self.checkpoint_path)
+                mgr = self._checkpoint_manager()
+                ckpt = mgr.latest_valid()
                 if ckpt is None:
                     raise
                 logger.exception(
                     "training failed; retry %d/%d from %s",
                     attempts, self.failure_retry_times, ckpt)
-                blob = load_checkpoint(ckpt)
-                self.model._params = blob["params"]
-                self.model._state = blob["model_state"]
-                # restore optimizer state too (reference reloads the
-                # OptimMethod state table) — else Adam moments/SGD velocity
-                # reset to zero and the resumed step spikes
-                self._resume_opt_state = blob["opt_state"]
-                if blob["driver_state"]:
-                    self.state.update(blob["driver_state"])
+                mgr.restore_into(self, ckpt, verified=True)
 
     def _optimize_impl(self):
         mesh = self.mesh
@@ -428,6 +444,7 @@ class DistriOptimizer(Optimizer):
         else:
             params, mstate = self.model.init(init_rng)
         self._resolve_grad_sync(mesh, params)
+        self._validate_resume_schema(params)
         if self._resume_opt_state is not None:
             ostate = self._resume_opt_state
             self._resume_opt_state = None

@@ -48,6 +48,7 @@ device, so clipping semantics match the reference exactly.
 from __future__ import annotations
 
 import logging
+import sys
 import time
 from typing import Any, Callable, Optional, Sequence
 
@@ -64,8 +65,9 @@ from bigdl_tpu.nn.module import Module
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger, max_epoch, probe_fire_step
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.checkpoint import (CheckpointManager, PreemptionHandler,
+                                  build_schema, validate_schema)
 from bigdl_tpu.telemetry import DriverTelemetry, NULL_SPAN, jit_cache_size
-from bigdl_tpu.utils.checkpoint import save_checkpoint
 from bigdl_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -144,6 +146,15 @@ class Optimizer:
         self.checkpoint_trigger: Optional[Trigger] = None
         self.checkpoint_path: Optional[str] = None
         self.overwrite_checkpoint = True
+        # retention/async knobs (None = Config defaults); the manager is
+        # built lazily so builder calls in any order all take effect
+        self.checkpoint_keep_last: Optional[int] = None
+        self.checkpoint_keep_every: Optional[int] = None
+        self.checkpoint_async: Optional[bool] = None
+        self.preemption_handling = False
+        self._ckpt_manager: Optional[CheckpointManager] = None
+        self._preemption: Optional[PreemptionHandler] = None
+        self._resume_schema: Optional[dict] = None
         self.grad_clip: Optional[Callable] = None
         self.grad_clip_spec: Optional[tuple] = None
         self.train_summary = None
@@ -195,14 +206,68 @@ class Optimizer:
         self.validation_dataset = dataset
         return self
 
-    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+    def set_checkpoint(self, path: str, trigger: Trigger,
+                       keep_last: Optional[int] = None,
+                       keep_every: Optional[int] = None,
+                       async_save: Optional[bool] = None) -> "Optimizer":
+        """Snapshot the FULL training state to ``path/model.<neval>``
+        whenever ``trigger`` fires (reference ``setCheckpoint``, now
+        backed by :mod:`bigdl_tpu.checkpoint`): atomic + checksummed,
+        committed on a background writer (``async_save``, default
+        ``Config.checkpoint_async``), retained per ``keep_last`` /
+        ``keep_every`` (defaults ``Config.checkpoint_keep_last/
+        _keep_every``)."""
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
+        self.checkpoint_keep_last = keep_last
+        self.checkpoint_keep_every = keep_every
+        self.checkpoint_async = async_save
+        if self._ckpt_manager is not None:
+            # stop the old manager's writer thread — reconfiguring must
+            # not strand a parked daemon per call
+            self._ckpt_manager.close(raise_errors=False)
+        self._ckpt_manager = None  # rebuilt with the new settings
         return self
 
-    def over_write_checkpoint(self) -> "Optimizer":
-        self.overwrite_checkpoint = True
+    def over_write_checkpoint(self, enabled: bool = True) -> "Optimizer":
+        """Allow (default) or forbid overwriting an existing
+        ``model.<neval>`` file — the reference's ``overWriteCheckpoint``
+        flag, both directions now real: with ``enabled=False`` a
+        colliding save raises ``FileExistsError`` instead of silently
+        replacing the older run's snapshot."""
+        self.overwrite_checkpoint = bool(enabled)
+        if self._ckpt_manager is not None:
+            self._ckpt_manager.overwrite = self.overwrite_checkpoint
         return self
+
+    def set_preemption_handling(self, enabled: bool = True) -> "Optimizer":
+        """Install a SIGTERM/SIGINT handler for the duration of
+        ``optimize()``: on signal the driver finishes the in-flight
+        block, writes one final synchronous snapshot to the checkpoint
+        path, and returns cleanly with ``state["preempted"] = True``
+        (requires ``set_checkpoint``).  Resume with :meth:`resume`."""
+        self.preemption_handling = bool(enabled)
+        return self
+
+    def resume(self, path: Optional[str] = None) -> bool:
+        """Restore the latest VALID snapshot (corrupt/torn ones are
+        skipped, never loaded) from the configured checkpoint directory
+        into this optimizer: model params/state, optimizer state
+        (schema-validated at ``optimize()``), driver counters, RNG seed
+        and dataset shuffle position — the next ``optimize()`` continues
+        mid-epoch exactly.  Returns False when no snapshot exists."""
+        if not self.checkpoint_path:
+            raise ValueError("resume() needs set_checkpoint(path, ...) "
+                             "so there is a directory to resume from")
+        mgr = self._checkpoint_manager()
+        verified = path is None
+        ckpt = path if path is not None else mgr.latest_valid()
+        if ckpt is None:
+            return False
+        mgr.restore_into(self, ckpt, verified=verified)
+        logger.info("resumed from %s (iteration %d)", ckpt,
+                    self.state.get("neval", 0))
+        return True
 
     def set_gradient_clipping_by_value(self, min_v: float,
                                        max_v: float) -> "Optimizer":
@@ -319,14 +384,21 @@ class Optimizer:
         """Mid-epoch resume: skip the samples already processed this epoch
         so the epoch boundary (and shuffle cadence) stays correct
         (reference: recordsProcessedThisEpoch in the OptimMethod state
-        table, ``DistriOptimizer.scala:124-134``)."""
-        skip = state.get("records_processed_this_epoch", 0)
+        table, ``DistriOptimizer.scala:124-134``).
+
+        ``records_processed_this_epoch`` counts GLOBAL records (the
+        replay adds ``n_local * scale``); the iterator here yields this
+        host's LOCAL batches, so the skip budget is the global count
+        divided back by the records scale (process_count under
+        multi-host SPMD — every host skips its own 1/P share)."""
+        scale = max(1, self._records_scale())
+        skip = state.get("records_processed_this_epoch", 0) // scale
         skipped = 0
         while skipped < skip:
             skipped += next(data_iter).size()
         if skipped:
-            logger.info("resume: skipped %d already-processed records",
-                        skipped)
+            logger.info("resume: skipped %d already-processed local "
+                        "records (of %d global)", skipped, skip * scale)
 
     def _tel_span(self, name: str, cat: str, **args):
         """Tracer span when telemetry is on; shared no-op otherwise —
@@ -336,17 +408,73 @@ class Optimizer:
             return NULL_SPAN
         return tel.tracer.span(name, cat=cat, **args)
 
+    def _checkpoint_manager(self) -> CheckpointManager:
+        if self._ckpt_manager is None:
+            from bigdl_tpu.utils.config import get_config
+            cfg = get_config()
+            pick = lambda v, d: d if v is None else v  # noqa: E731
+            self._ckpt_manager = CheckpointManager(
+                self.checkpoint_path,
+                keep_last=pick(self.checkpoint_keep_last,
+                               cfg.checkpoint_keep_last),
+                keep_every=pick(self.checkpoint_keep_every,
+                                cfg.checkpoint_keep_every),
+                overwrite=self.overwrite_checkpoint,
+                async_save=pick(self.checkpoint_async,
+                                cfg.checkpoint_async),
+                registry=self.metrics.registry)
+        return self._ckpt_manager
+
+    def _checkpoint_schema(self, params) -> dict:
+        """Manifest schema of THIS run's training state (the SPMD
+        subclass adds the grad_sync bucket plan)."""
+        return build_schema(
+            params, optim_method=type(self.optim_method).__name__)
+
+    def _model_params_schema(self) -> Optional[dict]:
+        """Shape/dtype fingerprint of THIS model's params — live params
+        when present, else ``jax.eval_shape`` over init (no compute) —
+        so ``CheckpointManager.restore_into`` can refuse an
+        architecture-drifted snapshot BEFORE overwriting the model."""
+        from bigdl_tpu.checkpoint.schema import describe_params
+        if self.model._params is not None:
+            return describe_params(self.model._params)
+        try:
+            shapes = jax.eval_shape(
+                lambda r: self.model.init(r)[0], jax.random.PRNGKey(0))
+        except Exception:  # init not eval_shape-able: the full-schema
+            return None    # check at optimize() still runs
+        return describe_params(shapes)
+
+    def _validate_resume_schema(self, params) -> None:
+        """Diff the restored snapshot's schema against this run —
+        grad_sync flips, bucket-plan drift, and architecture drift fail
+        loudly here instead of as a jit structure error."""
+        saved, self._resume_schema = self._resume_schema, None
+        if saved is not None:
+            validate_schema(saved, self._checkpoint_schema(params))
+
     def _maybe_checkpoint(self, params, mstate, ostate):
         if self.checkpoint_trigger and self.checkpoint_path \
                 and self.checkpoint_trigger(self.state):
             with self._tel_span("checkpoint", "trigger",
                                 neval=self.state["neval"]):
-                f = save_checkpoint(self.checkpoint_path, params, mstate,
-                                    ostate,
-                                    driver_state=self.state,
-                                    neval=self.state["neval"],
-                                    overwrite=self.overwrite_checkpoint)
-            logger.info("checkpoint saved to %s", f)
+                self._do_checkpoint(params, mstate, ostate)
+
+    def _do_checkpoint(self, params, mstate, ostate,
+                       sync: bool = False) -> None:
+        """Snapshot the full training state at the CURRENT replayed
+        iteration.  Called only at replay boundaries, where the
+        one-block-behind loss fetch has already synced the producing
+        block — the capture inside ``CheckpointManager.save`` is a
+        D2H copy, never a pipeline drain (GL107 discipline)."""
+        mgr = self._checkpoint_manager()
+        pos = getattr(self.dataset, "position_state", None)
+        run_state = {"seed": self.seed,
+                     "dataset_position": pos() if pos is not None else None}
+        mgr.save(self.state["neval"], params, mstate, ostate,
+                 driver_state=dict(self.state), run_state=run_state,
+                 schema=self._checkpoint_schema(params), sync=sync)
 
     def _run_validation(self, params, mstate) -> Optional[dict]:
         if not (self.validation_trigger and self.validation_methods
@@ -491,6 +619,17 @@ class Optimizer:
             # optimizer — _tel_span/_replay_block read self._telemetry,
             # so a stale one would keep recording through an "off" run
             self._telemetry = None
+        # checkpointing: manager built up front so the stall-fraction
+        # denominator starts at the run, and preemption (SIGTERM/SIGINT
+        # → finish block + final snapshot + clean return) has somewhere
+        # to write.  Both are inert when unconfigured.  A previous
+        # run's preempted verdict must not leak into this run's state
+        # (or its checkpoints).
+        state.pop("preempted", None)
+        mgr: Optional[CheckpointManager] = None
+        if self.checkpoint_path:
+            mgr = self._checkpoint_manager()
+            mgr.mark_run_start()
         epoch_size = self._epoch_size = self.dataset.size()
         data_iter = self.dataset.data(train=True)
         self._fast_forward(data_iter, state)
@@ -519,7 +658,7 @@ class Optimizer:
             Runs right after a dispatch, so the host stacking and the
             asynchronous host→device transfer overlap the in-flight
             block's compute — the double buffer."""
-            nonlocal rng, bsz_hint
+            nonlocal bsz_hint
             t_stage0 = time.perf_counter()
             probe_state = dict(state)
             probe_state.update(
@@ -537,10 +676,13 @@ class Optimizer:
             # order (schedules and the retry tests rely on that cadence)
             lrs = [float(self.optim_method.current_lr(p_neval + j, p_epoch))
                    for j in range(k)]
-            keys = []
-            for _ in range(k):
-                rng, step_rng = jax.random.split(rng)
-                keys.append(step_rng)
+            # per-step dropout keys are a PURE FUNCTION of (run key,
+            # iteration number) — fold_in, not sequential splits — so a
+            # mid-epoch resume re-derives exactly the keys the
+            # uninterrupted run used (bitwise-resume contract of
+            # bigdl_tpu.checkpoint), and the derivation is K-invariant
+            keys = [jax.random.fold_in(rng, p_neval + j)
+                    for j in range(k)]
             ends_epoch = p_records + sum(sizes) * scale >= epoch_size
             sync = ends_epoch or fire == k
             return _Staged(xs, ys, sizes, lrs,
@@ -552,8 +694,40 @@ class Optimizer:
 
         pending: Optional[_InFlight] = None
         staged: Optional[_Staged] = None
+        # installed LAST, immediately before the try whose finally
+        # uninstalls — an exception anywhere in run setup must never
+        # leave the process with hijacked (flag-only) signal handlers
+        preempt = None
+        if self.preemption_handling and mgr is not None:
+            preempt = self._preemption = PreemptionHandler()
+            preempt.install()
         try:
             while True:
+                if preempt is not None and preempt.triggered:
+                    # preemption: finish the in-flight block (replay
+                    # syncs it — params/state land on an exact block
+                    # boundary the uninterrupted run also hits), write
+                    # ONE final synchronous snapshot, return cleanly.
+                    # The planned-ahead `staged` block is discarded; its
+                    # batches are re-derived on resume from the saved
+                    # shuffle position + records counter.
+                    if pending is not None:
+                        self._replay_block(pending, params, mstate,
+                                           ostate)
+                        pending = None
+                    logger.warning(
+                        "preemption signal: final snapshot at iteration "
+                        "%d, exiting cleanly", state["neval"])
+                    mgr.wait()  # writer idle → no concurrent GC below
+                    if mgr.last_saved_step != state["neval"]:
+                        # a trigger checkpoint that fired on this very
+                        # iteration already covers it — don't burn the
+                        # grace window on a redundant serialize+fsync
+                        # (or trip over_write_checkpoint(False))
+                        self._do_checkpoint(params, mstate, ostate,
+                                            sync=True)
+                    state["preempted"] = True
+                    break
                 if staged is None:
                     if pending is None and self.end_when(state):
                         break
@@ -603,10 +777,26 @@ class Optimizer:
                 else:
                     pending = block
         finally:
+            run_failing = sys.exc_info()[0] is not None
+            if preempt is not None:
+                preempt.uninstall()
             if tel is not None:
                 # dump the Chrome trace even on an interrupted run — a
                 # crash timeline is precisely when you want the trace
                 tel.finalize()
+            if mgr is not None:
+                # drain pending async snapshot writes so optimize()
+                # returning means the checkpoints EXIST; a deferred
+                # write error fails the run loudly — unless the run is
+                # already failing (don't mask the original exception)
+                try:
+                    mgr.wait()
+                except Exception:
+                    if not run_failing:
+                        raise
+                    logger.exception(
+                        "async checkpoint write also failed during "
+                        "teardown of an already-failing run")
         return params, mstate, ostate
 
     def _replay_block(self, block: _InFlight, params, mstate, ostate):
@@ -752,6 +942,7 @@ class LocalOptimizer(Optimizer):
             mstate = jax.tree_util.tree_map(jnp.array, self.model._state)
         else:
             params, mstate = self.model.init(init_rng)
+        self._validate_resume_schema(params)
         if self._resume_opt_state is not None:
             ostate = self._resume_opt_state
             self._resume_opt_state = None

@@ -1,81 +1,36 @@
-"""Checkpoint save/load.
+"""Checkpoint save/load — thin back-compat shim over
+:mod:`bigdl_tpu.checkpoint`.
 
-Reference: ``Optimizer.setCheckpoint(path, trigger)`` saves
-``model.<neval>`` + ``optimMethod-<name>.<neval>`` via ``File.save``
-(``DistriOptimizer.scala:505-531``, ``utils/File.scala``); resume =
-``Module.load`` + ``OptimMethod.load``; epoch-position state lives in the
-OptimMethod state table so training resumes mid-epoch
-(``DistriOptimizer.scala:124-134,442-450``).
+The real machinery (atomic commit, CRC32c manifests, async writer,
+retention, latest-VALID discovery, schema validation, preemption) lives
+in ``bigdl_tpu/checkpoint/``; this module keeps the original
+``save_checkpoint`` / ``load_checkpoint`` / ``latest_checkpoint``
+signatures and the same safe data-only ``.npz`` wire, so every existing
+call site and on-disk checkpoint keeps working.  Files written here are
+v3 snapshots (they now carry a ``__manifest__`` member); v2 files load
+unchanged.
 
-Here a checkpoint is one file holding (params, model_state, opt_state,
-driver_state) as numpy pytrees — device arrays are pulled to host on save
-and restored with ``jnp.asarray`` on load.  Local filesystem only (the
-reference's HDFS/S3 paths have no analog in this environment).
-
-Format: a **data-only** ``.npz`` archive (arrays + a JSON skeleton
-describing the pytree structure) — deliberately NOT pickle, so loading a
-checkpoint from an untrusted directory cannot execute code (the reference
-inherits exactly that risk from Java serialization in ``File.load``; the
-retry path auto-loads whatever ``model.N`` file is present, so the format
-must be safe by construction).
+Reference lineage: ``Optimizer.setCheckpoint(path, trigger)`` saving
+``model.<neval>`` via ``File.save`` (``DistriOptimizer.scala:505-531``);
+the format is deliberately NOT pickle so loading a checkpoint from an
+untrusted directory cannot execute code.
 """
 
 from __future__ import annotations
 
-import json
 import os
-from typing import Any, Optional
+from typing import Optional
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+from bigdl_tpu.checkpoint.snapshot import (SnapshotError, decode_tree,
+                                           encode_tree, load_snapshot,
+                                           to_device, to_host,
+                                           write_snapshot)
 
-
-def _to_host(tree):
-    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
-
-
-def _to_device(tree):
-    return jax.tree_util.tree_map(
-        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)
-
-
-def _encode(tree, arrays: list):
-    """Pytree → JSON-able skeleton; array leaves appended to ``arrays``
-    and referenced by index."""
-    if isinstance(tree, dict):
-        return {"t": "dict",
-                "k": list(tree.keys()),
-                "v": [_encode(tree[k], arrays) for k in tree.keys()]}
-    if isinstance(tree, (list, tuple)):
-        return {"t": "list" if isinstance(tree, list) else "tuple",
-                "v": [_encode(x, arrays) for x in tree]}
-    if tree is None or isinstance(tree, (bool, int, float, str)):
-        return {"t": "py", "v": tree}
-    arr = np.asarray(tree)
-    if arr.dtype.name == "bfloat16":
-        # npz can't store ml_dtypes without pickle; round-trip via uint16
-        arrays.append(arr.view(np.uint16))
-        return {"t": "arr", "i": len(arrays) - 1, "d": "bfloat16"}
-    arrays.append(arr)
-    return {"t": "arr", "i": len(arrays) - 1}
-
-
-def _decode(node, arrays):
-    t = node["t"]
-    if t == "dict":
-        return {k: _decode(v, arrays) for k, v in zip(node["k"], node["v"])}
-    if t == "list":
-        return [_decode(v, arrays) for v in node["v"]]
-    if t == "tuple":
-        return tuple(_decode(v, arrays) for v in node["v"])
-    if t == "py":
-        return node["v"]
-    arr = arrays[f"a{node['i']}"]
-    if node.get("d") == "bfloat16":
-        import ml_dtypes
-        arr = arr.view(ml_dtypes.bfloat16)
-    return arr
+# historical private names, kept for back-compat importers
+_encode = encode_tree
+_decode = decode_tree
+_to_host = to_host
+_to_device = to_device
 
 
 def save_checkpoint(path: str, params, model_state=None, opt_state=None,
@@ -83,71 +38,44 @@ def save_checkpoint(path: str, params, model_state=None, opt_state=None,
                     neval: Optional[int] = None,
                     overwrite: bool = True) -> str:
     """Write a checkpoint.  With ``neval``, the file is ``model.<neval>``
-    inside ``path`` (reference naming); else ``path`` itself."""
+    inside ``path`` (reference naming); else ``path`` itself.
+    ``overwrite=False`` raises ``FileExistsError`` on an existing file —
+    the reference's unset ``overWriteCheckpoint``, now a real path."""
     if neval is not None:
         os.makedirs(path, exist_ok=True)
         fname = os.path.join(path, f"model.{neval}")
     else:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         fname = path
-    if os.path.exists(fname) and not overwrite:
-        raise FileExistsError(
-            f"{fname} exists (reference: overWriteCheckpoint not set)")
-    arrays: list = []
-    skeleton = {
-        "version": 2,
-        "params": _encode(_to_host(params), arrays),
-        "model_state": _encode(_to_host(model_state), arrays)
-        if model_state is not None else None,
-        "opt_state": _encode(_to_host(opt_state), arrays)
-        if opt_state is not None else None,
-        "driver_state": dict(driver_state) if driver_state else None,
-    }
-    tmp = fname + ".tmp"
-    with open(tmp, "wb") as f:
-        # stream straight to the file: no in-memory copy of the archive
-        np.savez(f, __meta__=np.frombuffer(
-            json.dumps(skeleton).encode(), dtype=np.uint8),
-            **{f"a{i}": a for i, a in enumerate(arrays)})
-    os.replace(tmp, fname)  # atomic: a crash never leaves a torn checkpoint
-    return fname
+    return write_snapshot(fname, params=to_host(params),
+                          model_state=to_host(model_state)
+                          if model_state is not None else None,
+                          opt_state=to_host(opt_state)
+                          if opt_state is not None else None,
+                          driver_state=driver_state, step=neval,
+                          overwrite=overwrite)
 
 
 def load_checkpoint(path: str):
-    """Load a checkpoint written by :func:`save_checkpoint`.  Returns a dict
-    with params/model_state/opt_state/driver_state (device arrays).
-    ``allow_pickle`` stays False: data-only by construction."""
+    """Load a checkpoint written by :func:`save_checkpoint` (or any
+    snapshot the new subsystem wrote).  Returns a dict with
+    params/model_state/opt_state/driver_state (device arrays).
+    Integrity-verified first: a torn or bit-flipped file raises instead
+    of deserializing garbage.  ``allow_pickle`` stays False: data-only
+    by construction."""
     try:
-        with np.load(path, allow_pickle=False) as z:
-            arrays = {k: z[k] for k in z.files}
-    except (ValueError, OSError) as e:
-        raise ValueError(
-            f"{path} is not a bigdl_tpu v2 (npz) checkpoint — legacy or "
-            "foreign formats are not auto-loaded (data-only policy); "
-            f"original error: {e}") from e
-    skeleton = json.loads(bytes(arrays.pop("__meta__")).decode())
-    return {
-        "params": _to_device(_decode(skeleton["params"], arrays)),
-        "model_state": _to_device(_decode(skeleton["model_state"], arrays))
-        if skeleton["model_state"] is not None else None,
-        "opt_state": _to_device(_decode(skeleton["opt_state"], arrays))
-        if skeleton["opt_state"] is not None else None,
-        "driver_state": skeleton["driver_state"],
-    }
+        blob = load_snapshot(path)
+    except SnapshotError as e:
+        raise ValueError(str(e)) from e
+    return {k: blob[k]
+            for k in ("params", "model_state", "opt_state", "driver_state")}
 
 
 def latest_checkpoint(folder: str) -> Optional[str]:
-    """Find the highest-neval ``model.N`` file (reference retry-from-latest,
-    ``DistriOptimizer.scala:981-1061``)."""
+    """Find the newest VALID ``model.N`` file (reference
+    retry-from-latest, ``DistriOptimizer.scala:981-1061``) — corrupt or
+    torn snapshots are skipped, never returned."""
     if not os.path.isdir(folder):
         return None
-    best, best_n = None, -1
-    for f in os.listdir(folder):
-        if f.startswith("model."):
-            try:
-                n = int(f.split(".", 1)[1])
-            except ValueError:
-                continue
-            if n > best_n:
-                best, best_n = os.path.join(folder, f), n
-    return best
+    from bigdl_tpu.checkpoint.manager import CheckpointManager
+    return CheckpointManager(folder).latest_valid()

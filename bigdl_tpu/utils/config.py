@@ -47,6 +47,16 @@ class Config:
     # The optimizer update always accumulates in f32 master slices.
     grad_bucket_bytes: int = 4 << 20
     grad_wire_dtype: str = "f32"
+    # checkpointing (bigdl_tpu/checkpoint — async fault-tolerant
+    # snapshots): retention keeps the newest checkpoint_keep_last
+    # snapshots plus (with checkpoint_keep_every=N) every N-th step
+    # forever; checkpoint_async=True commits snapshots on a bounded
+    # background writer thread so the driver pays only the device→host
+    # capture (checkpoint/stall_fraction gauge proves it) — False
+    # restores the synchronous inline write (debugging / tiny runs).
+    checkpoint_keep_last: int = 5
+    checkpoint_keep_every: int = 0
+    checkpoint_async: bool = True
     # serving (bigdl_tpu/serving — dynamic-batching inference engine):
     # a coalesced batch dispatches when it reaches serving_max_batch_size
     # rows or serving_batch_timeout_ms after its first request; the

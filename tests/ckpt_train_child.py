@@ -1,0 +1,114 @@
+"""Subprocess fault-injection child for tests/test_checkpoint.py.
+
+Trains a small MLP on synthetic MNIST with checkpointing enabled and
+appends one ``<step> <repr(loss)>`` line per replayed iteration to
+``--losses`` (line-buffered, so the parent can watch progress live and
+SIGKILL/SIGTERM the process mid-epoch).  With ``--resume`` it restores
+the latest valid snapshot first and trains to ``--iters``; with
+``--params-out`` it dumps the final params for bitwise comparison
+against the parent's uninterrupted reference run.
+
+The builders (``mlp``/``pipeline``/``build_optimizer``) are imported by
+the parent test so both processes construct byte-identical runs.
+
+Exit codes: 0 ok (including a clean preemption exit), 3 = --resume
+found no valid snapshot.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from bigdl_tpu import nn, optim  # noqa: E402
+from bigdl_tpu.dataset import DataSet, SampleToMiniBatch  # noqa: E402
+from bigdl_tpu.dataset import image, mnist  # noqa: E402
+
+N_SAMPLES, BATCH = 320, 32  # 10-step epochs — kills land mid-epoch
+
+
+def pipeline():
+    imgs, labels = mnist.synthetic_mnist(N_SAMPLES, seed=0)
+    return (DataSet.array(mnist.to_samples(imgs, labels))
+            >> image.BytesToGreyImg()
+            >> image.GreyImgNormalizer(mnist.TRAIN_MEAN, mnist.TRAIN_STD)
+            >> SampleToMiniBatch(BATCH))
+
+
+def mlp():
+    return (nn.Sequential()
+            .add(nn.Reshape((784,)))
+            .add(nn.Linear(784, 32)).add(nn.ReLU())
+            .add(nn.Linear(32, 10)).add(nn.LogSoftMax()))
+
+
+class LossLog:
+    """TrainSummary stand-in writing one line per replayed iteration."""
+
+    def __init__(self, path, fh=None):
+        self._fh = fh or open(path, "a", buffering=1)
+
+    def add_train_step(self, step, loss, lr, throughput):
+        self._fh.write(f"{step} {loss!r}\n")
+        self._fh.flush()
+
+    def add_scalar(self, tag, value, step):
+        pass
+
+    def trigger_for(self, name):
+        return None
+
+
+def build_optimizer(ckpt_dir, iters, k, grad_sync, every=3, summary=None):
+    cls = optim.DistriOptimizer if grad_sync else optim.LocalOptimizer
+    opt = (cls(mlp(), pipeline(), nn.ClassNLLCriterion())
+           .set_optim_method(optim.Adam(1e-3))
+           .set_steps_per_dispatch(k)
+           .set_seed(7)
+           .set_end_when(optim.max_iteration(iters))
+           .set_checkpoint(ckpt_dir, optim.several_iteration(every)))
+    if summary is not None:
+        opt.set_train_summary(summary)
+    return opt
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", required=True)
+    p.add_argument("--losses", required=True)
+    p.add_argument("--iters", type=int, default=16)
+    p.add_argument("--k", type=int, default=1)
+    p.add_argument("--every", type=int, default=3)
+    p.add_argument("--grad-sync", action="store_true")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--preemption", action="store_true")
+    p.add_argument("--params-out")
+    args = p.parse_args(argv)
+
+    opt = build_optimizer(args.dir, args.iters, args.k, args.grad_sync,
+                          every=args.every,
+                          summary=LossLog(args.losses))
+    if args.preemption:
+        opt.set_preemption_handling()
+    if args.resume and not opt.resume():
+        return 3
+    opt.optimize()
+    if args.params_out:
+        leaves = jax.tree_util.tree_leaves(opt.model._params)
+        np.savez(args.params_out,
+                 **{f"p{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    if opt.state.get("preempted"):
+        print(f"PREEMPTED {opt.state['neval']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

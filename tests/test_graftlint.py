@@ -704,6 +704,19 @@ class TestRealTree:
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 
+    def test_checkpoint_package_lints_clean(self):
+        """Same standalone discipline for the checkpoint package: its
+        one device fetch (snapshot.capture_to_host) is only legal at
+        the driver's replay boundary (catalog note "snapshot fetches
+        ride the replay boundary") and everything else is host-side
+        file I/O — a violation here means checkpoint code grew a
+        traced-scope sync or a fetch outside that boundary."""
+        result = lint_paths([os.path.join(REPO, "bigdl_tpu",
+                                          "checkpoint")])
+        assert result.files_scanned >= 5
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))
