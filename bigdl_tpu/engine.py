@@ -34,6 +34,11 @@ def _default_steps_per_dispatch() -> int:
     return get_config().steps_per_dispatch
 
 
+def _default_kernel_impl() -> str:
+    from bigdl_tpu.utils.config import get_config
+    return get_config().kernel_impl
+
+
 @dataclass
 class _EngineState:
     initialized: bool = False
@@ -49,6 +54,10 @@ class _EngineState:
     # Optimizer.set_steps_per_dispatch
     steps_per_dispatch: int = field(
         default_factory=_default_steps_per_dispatch)
+    # custom-kernel selection (ops/pallas_*.py): "auto" | "pallas" |
+    # "xla", default from Config.kernel_impl / BIGDL_TPU_KERNEL_IMPL;
+    # layers resolve it here unless given a per-layer ``impl=`` override
+    kernel_impl: str = field(default_factory=_default_kernel_impl)
     # whether Engine.set_xla_async_collectives has armed the XLA
     # latency-hiding scheduler flags (None = never touched)
     xla_async_collectives: Optional[bool] = None
@@ -128,6 +137,21 @@ class Engine:
         if int(k) < 1:
             raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
         cls._state.steps_per_dispatch = int(k)
+
+    @classmethod
+    def kernel_impl(cls) -> str:
+        """Process-wide custom-kernel choice (``auto|pallas|xla``) the
+        pallas-backed layers resolve when built without an explicit
+        ``impl=``; see ``Config.kernel_impl`` for the semantics and
+        ``ops.resolve_kernel_impl`` for the auto rule."""
+        return cls._state.kernel_impl
+
+    @classmethod
+    def set_kernel_impl(cls, impl: str) -> None:
+        if impl not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"kernel_impl must be auto|pallas|xla, got {impl!r}")
+        cls._state.kernel_impl = impl
 
     # -- serving -----------------------------------------------------------
     @classmethod

@@ -86,9 +86,14 @@ class WideAndDeep(Module):
     def __init__(self, wide_dim: int, deep_field_counts: Sequence[int],
                  dense_dim: int = 0, embed_dim: int = 16,
                  hidden: Sequence[int] = (100, 50),
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 kernel_impl: Optional[str] = None):
         super().__init__(name or "WideAndDeep")
-        self.wide = SparseLinear(wide_dim, 1)
+        # kernel_impl: COO wide-path kernel choice (auto|pallas|xla,
+        # None = Engine default) — "pallas" fuses the wide table's
+        # gather + scale + segment-sum (ops/pallas_embed.py), the
+        # entire Wide&Deep hot path per BENCH_r05
+        self.wide = SparseLinear(wide_dim, 1, impl=kernel_impl)
         self.deep_field_counts = list(deep_field_counts)
         self.embeds = [nn.LookupTable(c, embed_dim)
                        for c in self.deep_field_counts]

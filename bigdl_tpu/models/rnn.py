@@ -28,13 +28,18 @@ def simple_rnn(input_size: int = 128, hidden_size: int = 40,
 def ptb_model(vocab_size: int = 10000, embed_dim: int = 200,
               hidden_size: int = 200, num_layers: int = 2,
               dropout: float = 0.0,
-              scan_unroll: int = 1) -> nn.Sequential:
+              scan_unroll: int = 1,
+              kernel_impl=None) -> nn.Sequential:
     """PTB word LM (reference ``PTBModel.scala``): embedding → stacked LSTM
     → per-step Linear → LogSoftMax.  Input: int tokens (N, T).
 
     ``scan_unroll`` unrolls the time loop (exact math) — small-batch
-    LSTM steps are dispatch-bound on TPU; see Recurrent's docstring."""
-    cells = [LSTM(embed_dim if i == 0 else hidden_size, hidden_size)
+    LSTM steps are dispatch-bound on TPU; see Recurrent's docstring.
+    ``kernel_impl`` (``auto|pallas|xla``, None = Engine default) selects
+    the LSTM-cell kernel — ``"pallas"`` fuses the per-step gate chain
+    into one VMEM-resident pass (ops/pallas_lstm.py)."""
+    cells = [LSTM(embed_dim if i == 0 else hidden_size, hidden_size,
+                  impl=kernel_impl)
              for i in range(num_layers)]
     m = (nn.Sequential(name="PTBModel")
          .add(nn.LookupTable(vocab_size, embed_dim)))

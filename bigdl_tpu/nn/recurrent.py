@@ -116,13 +116,25 @@ class RnnCell(Cell):
 
 class LSTM(Cell):
     """LSTM cell (reference ``LSTM.scala``): gates i,f,g,o from one fused
-    projection of [x, h] — a single MXU matmul per step."""
+    projection of [x, h] — a single MXU matmul per step.
+
+    ``impl`` selects the scan-body cell kernel for the hoisted path:
+    ``None`` defers to ``Engine.kernel_impl()`` (``Config.kernel_impl``
+    / ``BIGDL_TPU_KERNEL_IMPL``), ``"pallas"`` opts into the fused
+    VMEM-resident cell (``ops/pallas_lstm.py`` — recurrent matmul with
+    f32 accumulation + all four gates + cell/hidden update in one pass,
+    replacing this chain of per-op HBM round-trips), ``"xla"`` pins the
+    baseline lowering.  Unsupported shapes silently take the XLA path
+    (``pallas_lstm.supported``); parity is gated in
+    ``tests/test_pallas_kernels.py``."""
 
     def __init__(self, input_size: int, hidden_size: int,
-                 forget_bias: float = 0.0, name: Optional[str] = None):
+                 forget_bias: float = 0.0, name: Optional[str] = None,
+                 impl: Optional[str] = None):
         super().__init__(name)
         self.input_size, self.hidden_size = input_size, hidden_size
         self.forget_bias = forget_bias
+        self.impl = impl
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
@@ -159,10 +171,28 @@ class LSTM(Cell):
 
     def step_hoisted(self, params, zx_t, hidden):
         h, c = hidden
+        if self._fused_cell_engaged(h):
+            from bigdl_tpu.ops.pallas_lstm import lstm_cell
+            # (H, 4H) transposed recurrent slice; loop-invariant, so
+            # XLA hoists the transpose out of the scan
+            w_t = params["weight"][:, self.input_size:].T
+            h_new, c_new = lstm_cell(zx_t, h, c, w_t,
+                                     forget_bias=self.forget_bias)
+            return h_new, (h_new, c_new)
         # the loop-invariant W_h slice is hoisted out of the scan by
         # XLA's while-loop invariant code motion
         z = zx_t + h @ params["weight"][:, self.input_size:].T
         return self._gates(z, c)
+
+    def _fused_cell_engaged(self, h) -> bool:
+        """Static (trace-time) kernel choice: resolved impl says pallas
+        AND the measured supported() gate passes for this shape/dtype —
+        anything else silently keeps the XLA chain."""
+        from bigdl_tpu.ops import pallas_lstm, resolve_kernel_impl
+        if resolve_kernel_impl(self.impl) != "pallas":
+            return False
+        return pallas_lstm.supported(h.shape[0], self.hidden_size,
+                                     h.dtype.type)
 
 
 class LSTMPeephole(Cell):

@@ -65,10 +65,25 @@ jax.tree_util.register_dataclass(
     meta_fields=["dense_shape"])
 
 
-def coo_spmm(coo: COOBatch, dense):
+def coo_spmm(coo: COOBatch, dense, impl: Optional[str] = None):
     """Sparse×dense matmul ``(N, D) @ (D, O) -> (N, O)`` as gather +
     segment-sum (the reference's ``SparseTensorBLAS`` coomm role, built
-    on the TPU-friendly primitive instead of a sparse gemm)."""
+    on the TPU-friendly primitive instead of a sparse gemm).
+
+    ``impl``: custom-kernel selection (``None`` defers to
+    ``Engine.kernel_impl()``).  With ``"pallas"`` and a shape the
+    measured ``pallas_embed.supported`` gate accepts, the whole
+    gather + scale + segment-accumulate runs as ONE fused kernel with
+    no materialized ``(nnz, O)`` intermediate — the Wide&Deep hot path
+    (``ops/pallas_embed.py``); anything else takes this XLA chain."""
+    from bigdl_tpu.ops import pallas_embed, resolve_kernel_impl
+    # static gate: impl resolution is host config, n_rows/dense_shape
+    # are pytree metadata and shapes/dtypes are trace-time constants
+    # graftlint: disable=GL102
+    if resolve_kernel_impl(impl) == "pallas" and pallas_embed.supported(
+            coo.row.shape[0], coo.n_rows, dense.shape, dense.dtype):
+        return pallas_embed.embedding_bag_coo(
+            coo.row, coo.col, coo.values, dense, coo.n_rows)
     gathered = jnp.take(dense, coo.col, axis=0) * coo.values[:, None]
     return jax.ops.segment_sum(gathered, coo.row,
                                num_segments=coo.n_rows)
@@ -128,13 +143,16 @@ class LookupTableSparse(Module):
     Output: (N, n_output)."""
 
     def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
-                 weight_init=None, name: Optional[str] = None):
+                 weight_init=None, name: Optional[str] = None,
+                 impl: Optional[str] = None):
         super().__init__(name)
         assert combiner in ("sum", "mean", "sqrtn")
         self.n_index = n_index
         self.n_output = n_output
         self.combiner = combiner
         self.weight_init = weight_init or RandomNormal(0.0, 0.05)
+        # COO-path kernel choice (see coo_spmm); None = Engine default
+        self.impl = impl
 
     def init(self, rng):
         w = self.weight_init.init(rng, (self.n_index, self.n_output),
@@ -142,7 +160,7 @@ class LookupTableSparse(Module):
         return {"weight": w}, {}
 
     def _apply_coo(self, params, coo: COOBatch):
-        summed = coo_spmm(coo, params["weight"])
+        summed = coo_spmm(coo, params["weight"], impl=self.impl)
         if self.combiner == "sum":
             return summed
         w = coo.values
@@ -190,13 +208,17 @@ class SparseLinear(Module):
     bias — mathematically identical to W @ x + b."""
 
     def __init__(self, input_size: int, output_size: int,
-                 with_bias: bool = True, name: Optional[str] = None):
+                 with_bias: bool = True, name: Optional[str] = None,
+                 impl: Optional[str] = None):
         super().__init__(name)
         self.input_size = input_size
         self.output_size = output_size
         self.with_bias = with_bias
+        # COO-path kernel choice (see coo_spmm); None = Engine default
+        self.impl = impl
         self._bag = LookupTableSparse(input_size, output_size, "sum",
-                                      weight_init=RandomUniform())
+                                      weight_init=RandomUniform(),
+                                      impl=impl)
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
@@ -209,7 +231,7 @@ class SparseLinear(Module):
 
     def apply(self, params, state, input, *, training=False, rng=None):
         if isinstance(input, COOBatch):
-            y = coo_spmm(input, params["weight"])
+            y = coo_spmm(input, params["weight"], impl=self.impl)
         else:
             y, _ = self._bag.apply({"weight": params["weight"]}, {}, input)
         if self.with_bias:

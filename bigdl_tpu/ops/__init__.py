@@ -14,4 +14,28 @@ and grows with it.
 
 from bigdl_tpu.ops.registry import OPS, register_op, get_op
 
-__all__ = ["OPS", "register_op", "get_op"]
+
+def resolve_kernel_impl(override=None) -> str:
+    """Resolve the effective custom-kernel backend: ``"pallas"`` or
+    ``"xla"``.
+
+    Per-layer ``impl=`` override wins; otherwise ``Engine.kernel_impl()``
+    (``Config.kernel_impl`` / ``BIGDL_TPU_KERNEL_IMPL``).  ``"auto"``
+    means pallas-if-supported on a TPU backend and xla elsewhere —
+    interpret-mode kernels are correctness emulation, not a speedup, so
+    auto never engages them on CPU hosts (force with ``"pallas"``,
+    which tests and the bench entries do).  Runs at trace time on the
+    host — the choice is static per compiled program, one more knob
+    the autotuner can sweep (ROADMAP item 3)."""
+    from bigdl_tpu.engine import Engine
+    impl = override if override is not None else Engine.kernel_impl()
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"kernel impl must be auto|pallas|xla, got {impl!r}")
+    if impl == "auto":
+        import jax
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return impl
+
+
+__all__ = ["OPS", "register_op", "get_op", "resolve_kernel_impl"]

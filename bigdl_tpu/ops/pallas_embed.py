@@ -1,0 +1,208 @@
+"""Pallas TPU kernel: fused embedding-bag / COO segment-sum (opt-in).
+
+Why this kernel exists: BENCH_r05 has Wide&Deep at MFU 0.0035 — the
+step is pure gather/segment-sum traffic over the wide table
+(``nn/sparse.py`` ``coo_spmm``).  XLA lowers that path as
+``take`` → ``multiply`` → ``scatter-add``, materializing the
+``(nnz, D)`` gathered-and-scaled intermediate in HBM twice (the gather
+write and the multiply) before the segment reduction reads it again.
+This kernel runs gather + scale + segment-accumulate in ONE pass: the
+output accumulator lives in VMEM for the whole kernel, table rows are
+double-buffered per-row async DMAs from HBM, and the per-chunk
+row/col/value streams ride SMEM block specs — the ``(nnz, D)``
+intermediate never exists.  HBM traffic per step drops to the gathered
+table rows + the flat index/value streams + one output write.
+
+Accumulation is f32 in VMEM regardless of operand dtype; the output is
+cast to the same promoted dtype the XLA path produces.  Because the
+accumulator is read-modify-write on a resident ref, ROW ORDER DOES NOT
+MATTER — unsorted COO, padding entries (row 0, col 0, value 0), empty
+rows and duplicate (row, col) pairs all accumulate correctly, so this
+kernel accepts exactly what ``coo_spmm`` accepts.
+
+Backward: ``jax.custom_vjp``.  The weight gradient deliberately stays
+on XLA's scatter-add — the r5 on-chip ablation measured XLA's scatter
+as the best known formulation for the random-update weight grad
+(sort+segsum measured worse; see bench.py Wide&Deep notes) — and
+``d_values`` is a row-dot also left to XLA.  The forward is where the
+fused win lives.
+
+Gating discipline: opt-in behind ``impl``/``Config.kernel_impl`` with
+a static :func:`supported` gate and silent XLA fallback, parity gated
+bitwise-or-tolerance (fwd + grad) in ``tests/test_pallas_kernels.py``
+under interpret mode on CPU.  Constraint provenance:
+``bigdl_tpu/ops/PALLAS_NOTES.md`` (no scatter-add primitive → VMEM
+accumulator; SMEM is KBs → per-chunk scalar streams; gather = per-row
+DMA).  On-chip bytes/step are carried measurement debt; the canned-HLO
+byte gate lives in ``tests/test_byte_audit.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bigdl_tpu.ops.pallas_util import (interpret_default as
+                                       _interpret_default,
+                                       lane_pad as _lane_pad)
+
+# nnz entries processed per grid step; the SMEM footprint per step is
+# 3 streams x _CHUNK x 4 B = 3 KB (SMEM is small — never block a whole
+# nnz stream into it, PALLAS_NOTES.md)
+_CHUNK = 256
+
+# VMEM element budget for the resident (n_rows, lane-padded D) output
+# accumulator: the census Wide&Deep wide path (8192 x pad(1)=128 =
+# 1.05M elements, 4.2 MB f32) must pass with headroom for the DMA
+# buffers; bigger outputs silently keep the XLA segment-sum.
+# PROVISIONAL pending on-chip validation (carried measurement debt,
+# ROADMAP item 2a): pallas_pool's 410K compile-abort budget was
+# measured on 5-D spatial blocks, not a flat 2-D accumulator — and the
+# D=1 wide path's padded count is tile padding, not live data (8192
+# rows x 128 lanes = 4.2 MB physical, far under VMEM).  If on-chip
+# Mosaic balks, lowering THIS constant is the one-line fix the
+# supported() gate makes safe (oversize sites fall back to XLA).
+_OUT_ELEMENT_BUDGET = 1_300_000
+
+
+def supported(nnz: int, n_rows: int, table_shape, dtype) -> bool:
+    """Whether the fused bag covers this (nnz, N, table, dtype) config.
+
+    Static and conservative: f32/bf16 tables, feature dim either
+    lane-aligned or within one lane group (narrow-D rows ride the DMA
+    path, which is byte- not lane-granular), and the VMEM output
+    accumulator within the element budget."""
+    if np.dtype(dtype) not in (np.dtype(jnp.float32),
+                               np.dtype(jnp.bfloat16)):
+        return False
+    if nnz < 1 or n_rows < 1:
+        return False
+    V, D = table_shape
+    if not (D % 128 == 0 or D <= 128):
+        return False
+    return n_rows * _lane_pad(D) <= _OUT_ELEMENT_BUDGET
+
+
+def _bag_kernel(rows_ref, cols_ref, vals_ref, table_ref, out_ref, buf,
+                sem, *, chunk):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        # the accumulator block is VMEM-resident across every grid step
+        # (constant index_map); zero it exactly once
+        out_ref[...] = jnp.zeros(out_ref.shape, out_ref.dtype)
+
+    def dma(slot, j):
+        # one table row HBM -> VMEM; byte-granular, so any D is legal
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(cols_ref[j], 1), :],
+            buf.at[slot], sem.at[slot])
+
+    dma(0, 0).start()
+
+    def body(j, _):
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < chunk)
+        def _():
+            dma(nxt, j + 1).start()  # overlap the next gather
+
+        dma(slot, j).wait()
+        r = rows_ref[j]
+        contrib = vals_ref[j] * buf[slot].astype(jnp.float32)
+        # read-modify-write on an unstrided (1, D) sub-range — the
+        # Mosaic-legal accumulate (no scatter-add primitive)
+        out_ref[pl.ds(r, 1), :] = out_ref[pl.ds(r, 1), :] + contrib
+        return 0
+
+    jax.lax.fori_loop(0, chunk, body, 0)
+
+
+@functools.lru_cache(maxsize=32)
+def _bag_fn(n_rows: int, interpret: bool):
+    """Cached custom-vjp fused bag for one static (n_rows, interpret)."""
+
+    @jax.custom_vjp
+    def bag(rows, cols, values, table):
+        return _fwd(rows, cols, values, table)[0]
+
+    def _run_kernel(rows, cols, values, table):
+        # promoted output dtype from the ORIGINAL operand dtypes (the
+        # XLA chain's result dtype); accumulation itself is f32
+        out_dtype = jnp.result_type(table.dtype, values.dtype)
+        values = values.astype(jnp.float32)
+        nnz = rows.shape[0]
+        pad = -nnz % _CHUNK
+        if pad:
+            # padding entries (row 0, col 0, value 0) contribute nothing
+            rows = jnp.pad(rows, (0, pad))
+            cols = jnp.pad(cols, (0, pad))
+            values = jnp.pad(values, (0, pad))
+        D = table.shape[1]
+        grid = (rows.shape[0] // _CHUNK,)
+        kern = functools.partial(_bag_kernel, chunk=_CHUNK)
+        out = pl.pallas_call(
+            kern,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((_CHUNK,), lambda i: (i,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((_CHUNK,), lambda i: (i,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((_CHUNK,), lambda i: (i,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.ANY),  # table stays HBM
+            ],
+            out_specs=pl.BlockSpec((n_rows, D), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((n_rows, D), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((2, 1, D), table.dtype),
+                            pltpu.SemaphoreType.DMA((2,))],
+            interpret=interpret,
+        )(rows, cols, values, table)
+        return out.astype(out_dtype)
+
+    def _fwd(rows, cols, values, table):
+        out = _run_kernel(rows, cols, values, table)
+        return out, (rows, cols, values, table)
+
+    def _bwd(res, g):
+        rows, cols, values, table = res
+        gf = g.astype(jnp.float32)
+        g_rows = jnp.take(gf, rows, axis=0)  # (nnz, D)
+        # weight grad: XLA's scatter-add — measured best-known for the
+        # random-update pattern (module docstring / bench r5 notes)
+        d_table = jnp.zeros(table.shape, jnp.float32).at[cols].add(
+            values.astype(jnp.float32)[:, None] * g_rows)
+        d_values = jnp.sum(
+            g_rows * jnp.take(table, cols, axis=0).astype(jnp.float32),
+            axis=1)
+        int0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)  # noqa: E731
+        return (int0(rows), int0(cols), d_values.astype(values.dtype),
+                d_table.astype(table.dtype))
+
+    bag.defvjp(_fwd, _bwd)
+    return bag
+
+
+def embedding_bag_coo(rows, cols, values, table, n_rows: int, *,
+                      interpret=None):
+    """Fused COO embedding-bag: ``out[r] += values[k] * table[cols[k]]``
+    for every non-zero ``k`` with ``rows[k] == r``, in one pass.
+
+    Drop-in for the ``coo_spmm`` gather→scale→segment_sum chain
+    (identical semantics for unsorted rows, duplicates, padding zeros
+    and empty segments).  Differentiable; the weight grad keeps XLA's
+    scatter-add.  Caller is responsible for checking :func:`supported`.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    fn = _bag_fn(int(n_rows), bool(interpret))
+    return fn(rows.astype(jnp.int32), cols.astype(jnp.int32), values,
+              table)

@@ -24,23 +24,29 @@ bytes/step 37.3→80.4 GB).  Until pallas grows input-layout control,
 kernel is opt-in (``impl="pallas_bwd"``), retained as the reference
 first-match implementation and for layout-friendly call-sites.
 
-r5 addendum (jax 0.9): 0.9 did NOT gain pallas input-layout control —
-the copy penalty stands — and its Mosaic additionally fails to compile
-the large-spatial blocks that 0.8 accepted (see :func:`supported`,
-which now gates on a measured 410K per-block ELEMENT budget and
-falls back).
+r5 addendum, refreshed round-10 (the fused-kernel PR): the PINNED
+toolchain — whichever jax/jaxlib the bench ``toolchain`` stamp names
+for a given capture; cross-version claims were the r4→r5 trap — still
+has NO pallas input-layout control, so the copy penalty around
+batch-minor conv activations stands, and its Mosaic rejects the
+large-spatial blocks an earlier toolchain accepted (see
+:func:`supported`, which gates on the measured 410K per-block ELEMENT
+budget and falls back).  Re-verify BOTH facts per toolchain bump; the
+2-D-activation kernels (``pallas_lstm.py``, ``pallas_embed.py``) are
+unaffected by the layout issue because their operands use default
+row-major layouts.
 
-Mosaic lowering constraints discovered on v5e, which shape the design:
-- no scatter-add; no rank-changing vector reshapes; strided vector
-  loads/stores don't lower for bf16 (sublane-packed) or >128 lanes.
-- therefore ALL strided window access is factored out as free XLA
-  reshapes: ``(N, H, W, C) -> (N, H/sh, sh, W/sw, sw*C)`` regroups
-  contiguous memory, so a window offset ``d = q*s + r`` becomes an
-  UNSTRIDED slice ``[i+q, r]`` of the reshaped array, and the
-  ``r``-selection on W is a lane-range slice (128-aligned once C is
-  padded to a lane multiple).
-- gradient accumulation is read-modify-write on the output ref over
-  those unstrided sub-ranges.
+The Mosaic lowering constraints that shape this design (no
+scatter-add, lane-width/strided-access rules, the element budget,
+f32-compare masks) are canonical in ``bigdl_tpu/ops/PALLAS_NOTES.md``
+— kept there so every ops/pallas_* kernel cites ONE constraints doc
+instead of restating and drifting.  Specific to this kernel: all
+strided window access is factored out as free XLA reshapes
+(``(N, H, W, C) -> (N, H/sh, sh, W/sw, sw*C)`` regroups contiguous
+memory, so a window offset ``d = q*s + r`` becomes an UNSTRIDED slice
+``[i+q, r]`` with the ``r``-selection a 128-aligned lane-range slice),
+and gradient accumulation is read-modify-write on the output ref over
+those unstrided sub-ranges.
 """
 
 from __future__ import annotations
@@ -106,17 +112,19 @@ def supported(x_shape, kernel, stride, pads):
     """Whether the pallas backward covers this pooling config.
 
     Besides the structural conditions, a per-block ELEMENT budget gate:
-    jax 0.9's Mosaic aborts compilation (axon compile-helper exit 1, no
-    diagnostic) for the large-spatial blocks that compiled fine under
-    0.8.  The limit is element count, not bytes — measured on v5e:
-    802,816-element blocks fail in BOTH f32 (112²×64, 56²×192) and
-    bf16 (112²×64, i.e. half the bytes), while 401,408-element blocks
-    (28²×480-pad-512, 56²×128) compile in both dtypes — consistent
-    with bf16's (2,1) sublane packing keeping vreg footprint
-    proportional to elements.  Gate at 410,000 elements (just above
-    the largest measured-good block) so bigger sites silently take the
-    documented reduce_window fallback instead of a runtime compile
-    error."""
+    the pinned toolchain's Mosaic aborts compilation (compile-helper
+    exit 1, no diagnostic) for the large-spatial blocks an earlier
+    toolchain accepted — re-verify per bump, keyed to the bench
+    ``toolchain`` stamp.  The limit is element count, not bytes —
+    measured on v5e: 802,816-element blocks fail in BOTH f32 (112²×64,
+    56²×192) and bf16 (112²×64, i.e. half the bytes), while
+    401,408-element blocks (28²×480-pad-512, 56²×128) compile in both
+    dtypes — consistent with bf16's (2,1) sublane packing keeping vreg
+    footprint proportional to elements (the canonical budget note
+    lives in ops/PALLAS_NOTES.md).  Gate at 410,000 elements (just
+    above the largest measured-good block) so bigger sites silently
+    take the documented reduce_window fallback instead of a runtime
+    compile error."""
     _, H, W, C = x_shape
     (kh, kw), (sh, sw) = kernel, stride
     if not (H % sh == 0 and W % sw == 0 and kh >= sh and kw >= sw):

@@ -1,0 +1,32 @@
+"""Shared helpers for the ops/pallas_* kernel wrappers.
+
+One definition site so the padding/interpret conventions cannot drift
+between kernels (PALLAS_NOTES.md "wrapper pads, kernel assumes
+alignment").  NOTE: ``ops/pallas_pool.py`` keeps its own ``_lane_pad``
+deliberately — its semantics differ (no 128-lane minimum: channels
+C <= 128 stay unpadded because its lane axis carries ``sw*C`` groups);
+don't "unify" them without re-deriving that kernel's slicing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def lane_pad(n: int) -> int:
+    """Smallest 128-lane multiple >= n (min one full lane group)."""
+    return max(128, -(-n // 128) * 128)
+
+
+def sublane_multiple(dtype) -> int:
+    """Minimum sublane multiple for a dtype's vreg tile: (8, 128) f32,
+    (16, 128) bf16 (PALLAS_NOTES.md tiling minimums)."""
+    import numpy as np
+    return 16 if np.dtype(dtype) == np.dtype(jnp.bfloat16) else 8
+
+
+def interpret_default() -> bool:
+    """Run the real kernel body under the Pallas interpreter off-TPU so
+    tier-1 (CPU) exercises this code path."""
+    return jax.default_backend() != "tpu"

@@ -179,6 +179,10 @@ class Optimizer:
         self._eval_fwd = None  # cached jit'd eval forward
         self._resume_opt_state = None  # optimizer state restored on retry
         self.compute_dtype = None  # None = full f32; jnp.bfloat16 for MXU
+        # activation-memory policy (set_activation_memory): None/"none"
+        # = inert (bitwise-identical driver), else remat and/or bf16
+        # activation storage for HBM-bound workloads
+        self.activation_memory: Optional[str] = None
         self._dispatch_count = 0  # jit dispatches issued (observability)
         self._stager: Optional[DeviceBlockStager] = None
         self._epoch_size = 0
@@ -306,6 +310,44 @@ class Optimizer:
         self.compute_dtype = dtype
         return self
 
+    _ACTIVATION_POLICIES = ("none", "bf16", "dots", "full", "bf16+dots",
+                            "bf16+full")
+
+    def set_activation_memory(self, policy: Optional[str]) -> "Optimizer":
+        """Trade MXU headroom for HBM traffic on workloads pinned to
+        the memory wall (BENCH: hbm_floor_fraction > 0.9).
+
+        ``policy``:
+
+        - ``None`` / ``"none"`` — inert: the step function is built
+          exactly as before (bitwise-identical loss sequence, same
+          dispatch count).
+        - ``"dots"`` — selective rematerialization via
+          ``jax.checkpoint(policy=checkpoint_dots)``: matmul outputs
+          are saved, everything elementwise is recomputed in the
+          backward instead of round-tripping through HBM.
+        - ``"full"`` — full rematerialization
+          (``nothing_saveable``): only the step inputs are saved; the
+          whole forward is recomputed during the backward.  Exact math
+          — remat changes WHAT is stored, never what is computed, so
+          the loss trajectory is unchanged to float rounding (XLA may
+          fuse the recomputed chain differently).
+        - ``"bf16"`` — bf16 activation storage: forward/backward
+          compute (and therefore every stored activation) in bf16 via
+          the mixed-precision loss path; master params, gradients as
+          applied, and the optimizer update stay f32.  A no-op when
+          ``set_compute_dtype(bf16)`` is already active.
+        - ``"bf16+dots"`` / ``"bf16+full"`` — both.
+
+        Only activation dtypes/remat change — never params or update
+        math (gated in tests/test_pallas_kernels.py)."""
+        if policy is not None and policy not in self._ACTIVATION_POLICIES:
+            raise ValueError(
+                f"activation memory policy must be one of "
+                f"{self._ACTIVATION_POLICIES} or None, got {policy!r}")
+        self.activation_memory = policy
+        return self
+
     def set_steps_per_dispatch(self, k: int) -> "Optimizer":
         """Fuse ``k`` consecutive train steps into one jit dispatch
         (``lax.scan`` over stacked microbatches).  Loss trajectory and
@@ -355,10 +397,26 @@ class Optimizer:
     # ------------------------------------------------------------- shared
     def _loss_and_grad_fn(self):
         model, criterion = self.model, self.criterion
-        if self.compute_dtype is not None:
+        policy = self.activation_memory or "none"
+        compute_dtype = self.compute_dtype
+        if policy.startswith("bf16"):
+            if compute_dtype is not None and compute_dtype != jnp.bfloat16:
+                # refusing beats silently dropping the requested
+                # storage downcast: an explicit non-bf16 compute dtype
+                # contradicts a bf16 activation policy
+                raise ValueError(
+                    f"set_activation_memory({self.activation_memory!r}) "
+                    f"conflicts with set_compute_dtype({compute_dtype}) "
+                    f"— bf16 activation storage IS bf16 compute; drop "
+                    f"one of the two settings")
+            # bf16 activation storage: stored residuals are bf16 because
+            # the fwd/bwd compute is — params/update stay f32 by the
+            # mixed-precision contract (utils/precision.py)
+            compute_dtype = jnp.bfloat16
+        if compute_dtype is not None:
             from bigdl_tpu.utils.precision import mixed_precision_loss_fn
             loss_fn = mixed_precision_loss_fn(model, criterion,
-                                              self.compute_dtype)
+                                              compute_dtype)
         else:
             def loss_fn(params, mstate, x, y, rng):
                 out, new_mstate = model.apply(params, mstate, x,
@@ -377,6 +435,16 @@ class Optimizer:
                 loss, new_mstate = _base(params, mstate, x, y, rng)
                 return loss + regularization_loss(model, params), \
                     new_mstate
+
+        if policy.endswith("dots") or policy.endswith("full"):
+            # selective remat over the whole loss computation: "dots"
+            # saves matmul outputs and recomputes the elementwise chain
+            # in the backward; "full" saves only the step inputs.
+            # Exact math either way — only the residual set changes.
+            remat_policy = (jax.checkpoint_policies.dots_saveable
+                            if policy.endswith("dots") else
+                            jax.checkpoint_policies.nothing_saveable)
+            loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
 
         return jax.value_and_grad(loss_fn, has_aux=True)
 

@@ -70,6 +70,17 @@ class Config:
     serving_max_batch_size: int = 32
     serving_batch_timeout_ms: float = 2.0
     serving_queue_capacity: int = 256
+    # custom-kernel selection (bigdl_tpu/ops/pallas_*.py — the fused
+    # LSTM cell and COO embedding-bag):  "xla" = always the baseline
+    # lowering; "pallas" = fused kernel wherever its measured
+    # supported() gate passes (silent XLA fallback otherwise; interpret
+    # mode off-TPU); "auto" = pallas-if-supported on a TPU backend, xla
+    # elsewhere (interpret-mode kernels are correctness-emulation, not
+    # a speedup, so auto never engages them on CPU hosts).  Resolved
+    # through Engine.kernel_impl() so the autotuner (ROADMAP item 3)
+    # inherits kernel choice as one more measured knob.  Env:
+    # BIGDL_TPU_KERNEL_IMPL.  Per-layer ``impl=`` constructor args win.
+    kernel_impl: str = "auto"
     # numerics
     compute_dtype: str = "float32"     # "bfloat16" flips matmul precision
     matmul_precision: str = "default"  # jax "default"|"high"|"highest"

@@ -21,7 +21,7 @@ size, never the producing tuple's total.
 import os
 
 from tools.byte_audit import (_operand_text, audit, collective_wire_bytes,
-                              shape_bytes)
+                              copy_audit, diff_audit, shape_bytes)
 
 FIX = os.path.join(os.path.dirname(__file__), "fixtures")
 
@@ -128,6 +128,71 @@ class TestCollectiveWireBytes:
         cw = collective_wire_bytes(_load("hlo_async_done.txt"))
         assert cw["all-reduce"] == AR
         assert cw["total"] == AR
+
+
+class TestDiffAudit:
+    """--diff (round-10): per-op-kind bytes delta between two HLO
+    dumps.  Regression-tested on the existing wire fixtures, plus the
+    ISSUE-8 acceptance gate: the canned fused PTB-LSTM / Wide&Deep step
+    programs show STRICTLY lower bytes than their XLA baselines, with
+    the baseline op kinds gone and one custom-call in their place."""
+
+    def test_wire_fixture_diff_matches_audit_totals(self):
+        d = diff_audit(_load("hlo_wire_f32.txt"), _load("hlo_wire_bf16.txt"))
+        a_by, _ = audit(_load("hlo_wire_f32.txt"), top=5)
+        b_by, _ = audit(_load("hlo_wire_bf16.txt"), top=5)
+        assert d["total_a"] == sum(a_by.values())
+        assert d["total_b"] == sum(b_by.values())
+        assert d["total_delta"] == d["total_b"] - d["total_a"]
+        # the wire payload table rides along and shows the bf16 halving
+        assert d["wire_b"]["total"] * 2 == d["wire_a"]["total"]
+        # per_op rows are sorted by |delta| descending
+        deltas = [abs(r[3]) for r in d["per_op"]]
+        assert deltas == sorted(deltas, reverse=True)
+
+    def test_ptb_cell_fused_strictly_lower(self):
+        d = diff_audit(_load("hlo_ptb_cell_xla.txt"),
+                       _load("hlo_ptb_cell_fused.txt"))
+        assert d["total_b"] < d["total_a"]  # the acceptance bar
+        per = {k: (a, b) for k, a, b, _ in d["per_op"]}
+        # the gate-chain op kinds vanish; one custom-call replaces them
+        for kind in ("dot", "slice", "logistic", "tanh", "multiply"):
+            assert per[kind][1] == 0, kind
+        assert per["custom-call"][0] == 0 and per["custom-call"][1] > 0
+
+    def test_wd_bag_fused_strictly_lower(self):
+        d = diff_audit(_load("hlo_wd_bag_xla.txt"),
+                       _load("hlo_wd_bag_fused.txt"))
+        assert d["total_b"] < d["total_a"]
+        per = {k: (a, b) for k, a, b, _ in d["per_op"]}
+        # no materialized (nnz, D) intermediate: gather/multiply/scatter
+        # all gone in the fused program
+        for kind in ("gather", "multiply", "scatter", "broadcast"):
+            assert per[kind][1] == 0, kind
+        assert per["custom-call"][1] > 0
+        # the dominant saving is the (nnz, D) round-trips: delta at
+        # least the two multiply operands' worth
+        assert d["total_a"] - d["total_b"] > 2 * 65536 * 16 * F32
+
+
+class TestCopyAudit:
+    """--audit-copies (round-10 donation/aliasing audit)."""
+
+    def test_finds_entry_copy_above_threshold(self):
+        # hlo_while_gte carries one f32[128,256] entry copy (131072 B)
+        found = copy_audit(_load("hlo_while_gte.txt"), min_bytes=65536)
+        assert [name for _, name, _ in found] == ["copy.1"]
+        assert found[0][0] == BIG
+
+    def test_threshold_filters_small_copies(self):
+        assert copy_audit(_load("hlo_while_gte.txt"),
+                          min_bytes=BIG + 1) == []
+
+    def test_nested_computation_copies_excluded(self):
+        # only ENTRY copies are donation-relevant; fused/while bodies
+        # never materialize
+        found = copy_audit(_load("hlo_wire_f32.txt"), min_bytes=1)
+        assert found == []
 
 
 class TestAsyncDoneFixture:

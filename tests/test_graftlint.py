@@ -704,6 +704,19 @@ class TestRealTree:
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 
+    def test_ops_package_lints_clean(self):
+        """Standalone gate for the custom-kernel modules (round-10,
+        ISSUE-8): ops/ holds pallas kernel bodies plus their
+        supported()/impl gating — all kernel-choice branching must be
+        host-static (shape/dtype/config), never tensor-valued, and
+        kernel wrappers must stay sync-free.  A violation here means a
+        kernel gate leaked into traced scope (see the catalog note
+        "kernel gating is host code")."""
+        result = lint_paths([os.path.join(REPO, "bigdl_tpu", "ops")])
+        assert result.files_scanned >= 4
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
     def test_checkpoint_package_lints_clean(self):
         """Same standalone discipline for the checkpoint package: its
         one device fetch (snapshot.capture_to_host) is only legal at

@@ -9,6 +9,28 @@ for instruction-by-instruction instead of asserted.
 Usage:
     python tools/byte_audit.py [--format NHWC|NCHW] [--batch N]
         [--remat none|tails|full] [--top N] [--cpu]
+    python tools/byte_audit.py --diff a.hlo b.hlo [--top N]
+    python tools/byte_audit.py --audit-copies prog.hlo [--min-bytes N]
+
+``--diff`` (round-10, the fused-kernel PR): side-by-side bytes
+comparison of two HLO dumps — per-op-kind delta table plus totals and
+collective wire payloads — so a kernel/layout win is provable from two
+``compiled.as_text()`` files instead of asserted (the canned
+PTB-LSTM / Wide&Deep step fixtures in tests/fixtures gate the fused
+kernels' strictly-lower-bytes claim this way).
+
+``--audit-copies`` (round-10, donation/aliasing audit): entry-
+computation ``copy``/``copy-start`` instructions at or above a size
+threshold, with shapes and source lines — the fingerprint of a
+donation or aliasing gap.  Findings from running it over the fused
+K-step dispatch (K=4, CPU host): every large copy is either (a) a
+donated-carry copy the CPU backend inserts because BUFFER DONATION IS
+NOT IMPLEMENTED ON CPU (on TPU the donated params/mstate/ostate alias
+in place), or (b) a layout copy around the scan-major transpose of the
+hoisted input projections — intrinsic to hoisting (one small copy per
+block vs T small matmuls), not an aliasing gap.  No unintended
+full-tensor copies on the donated path; re-run on-chip per toolchain
+bump (the CPU-host caveat makes host findings advisory).
 
 Prints:
 - cost_analysis totals (flops, bytes) + roofline floors;
@@ -220,6 +242,81 @@ def collective_wire_bytes(hlo_text: str) -> dict:
     return out
 
 
+# ------------------------------------------------- two-dump comparison
+def diff_audit(hlo_a: str, hlo_b: str, top: int = 20) -> dict:
+    """Per-op-kind bytes-accessed delta between two HLO dumps (A = the
+    baseline, B = the candidate).  Returns::
+
+        {"per_op": [(kind, bytes_a, bytes_b, bytes_b - bytes_a), ...],
+         "total_a": ..., "total_b": ..., "total_delta": ...,
+         "wire_a": {...}, "wire_b": {...}}
+
+    ``per_op`` is sorted by |delta| descending and includes kinds
+    present in either dump.  Totals are the summed per-op attributions
+    (RELATIVE comparison semantics — see :func:`audit`: use deltas
+    between dumps, not absolutes vs the cost model).  Collective wire
+    payloads ride along so wire-dtype comparisons read from the same
+    table."""
+    by_a, _ = audit(hlo_a, top)
+    by_b, _ = audit(hlo_b, top)
+    kinds = sorted(set(by_a) | set(by_b),
+                   key=lambda k: -abs(by_b.get(k, 0) - by_a.get(k, 0)))
+    per_op = [(k, by_a.get(k, 0), by_b.get(k, 0),
+               by_b.get(k, 0) - by_a.get(k, 0)) for k in kinds]
+    ta, tb = sum(by_a.values()), sum(by_b.values())
+    return {"per_op": per_op, "total_a": ta, "total_b": tb,
+            "total_delta": tb - ta,
+            "wire_a": collective_wire_bytes(hlo_a),
+            "wire_b": collective_wire_bytes(hlo_b)}
+
+
+def print_diff(d: dict) -> None:
+    print(f"{'op kind':28s} {'A (MB)':>12s} {'B (MB)':>12s} "
+          f"{'delta (MB)':>12s}")
+    for kind, a, b, delta in d["per_op"]:
+        print(f"{kind:28s} {a / 1e6:12.3f} {b / 1e6:12.3f} "
+              f"{delta / 1e6:+12.3f}")
+    print(f"{'TOTAL':28s} {d['total_a'] / 1e6:12.3f} "
+          f"{d['total_b'] / 1e6:12.3f} {d['total_delta'] / 1e6:+12.3f}")
+    if d["wire_a"]["total"] or d["wire_b"]["total"]:
+        print(f"{'collective wire total':28s} "
+              f"{d['wire_a']['total'] / 1e6:12.3f} "
+              f"{d['wire_b']['total'] / 1e6:12.3f} "
+              f"{(d['wire_b']['total'] - d['wire_a']['total']) / 1e6:+12.3f}")
+
+
+# --------------------------------------------- donation/aliasing audit
+def copy_audit(hlo_text: str, min_bytes: int = 1 << 20) -> list:
+    """Entry-computation ``copy``/``copy-start`` instructions moving at
+    least ``min_bytes`` (result size), as ``(bytes, name, line)``
+    tuples sorted largest first — the donation/aliasing-gap
+    fingerprint.  Interpretation guidance (and the findings from the
+    fused K-step dispatch) in the module docstring: on CPU hosts
+    donated carries are ALWAYS copied (donation unimplemented there),
+    so treat host results as advisory and re-audit on-chip."""
+    in_entry = False
+    found = []
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry and line.startswith("}"):
+            in_entry = False
+        if not in_entry:
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, shape_str, opcode = m.groups()
+        if opcode not in ("copy", "copy-start"):
+            continue
+        b = shape_bytes(shape_str)
+        if b >= min_bytes:
+            found.append((b, name, line.strip()))
+    found.sort(reverse=True)
+    return found
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--format", default="NHWC", choices=["NHWC", "NCHW"])
@@ -228,7 +325,31 @@ def main():
                     choices=["none", "tails", "full"])
     ap.add_argument("--top", type=int, default=20)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--diff", nargs=2, metavar=("A.hlo", "B.hlo"),
+                    help="per-op-kind bytes delta between two HLO dumps")
+    ap.add_argument("--audit-copies", metavar="PROG.hlo",
+                    help="entry copy/copy-start instructions >= "
+                         "--min-bytes (donation/aliasing audit)")
+    ap.add_argument("--min-bytes", type=int, default=1 << 20)
     args = ap.parse_args()
+
+    if args.diff:
+        with open(args.diff[0]) as fh:
+            a = fh.read()
+        with open(args.diff[1]) as fh:
+            b = fh.read()
+        print_diff(diff_audit(a, b, args.top))
+        return
+
+    if args.audit_copies:
+        with open(args.audit_copies) as fh:
+            text = fh.read()
+        found = copy_audit(text, args.min_bytes)
+        if not found:
+            print(f"no entry copies >= {args.min_bytes} bytes")
+        for b, name, line in found:
+            print(f"  {b / 1e6:9.3f}MB  {name:32s} {line[:110]}")
+        return
 
     if args.cpu:
         import jax
