@@ -195,10 +195,38 @@ ROOT = os.path.dirname(os.path.abspath(__file__))
 # trigger boundaries).  The conv nets run 35-100 ms steps at 0.82-0.95
 # of their HBM floor — dispatch is invisible there, and K>1 only
 # delays trigger/validation boundaries, so they stay at K=1.
-PRODUCTION_K = {
+_HAND_TUNED_K = {
     "resnet50": 1, "inception_v1": 1, "vgg16": 1,
     "ptb_lstm": 8, "wide_deep": 8,
 }
+
+
+class _ProductionK(dict):
+    """Deprecation shim (round-11, the autotuner PR): per-workload
+    production ``steps_per_dispatch`` now prefers the autotuned
+    ``tuned_configs.json`` entry for the live backend
+    (``tools/autotune.py`` output, read through
+    ``bigdl_tpu.utils.tuned``), falling back to the hand-maintained
+    round-7 dict this object still carries.  ``PRODUCTION_K[w]`` keeps
+    its historical int semantics; ``PRODUCTION_K.source(w)`` returns
+    ``(k, "tuned_configs.json" | "hand")`` and the capture JSON records
+    the source per entry (``dispatch_fuse_k_source``)."""
+
+    def source(self, workload):
+        try:
+            from bigdl_tpu.utils.tuned import lookup
+            v = lookup(workload, "steps_per_dispatch")
+        except Exception:
+            v = None  # tuned layer unavailable != bench unavailable
+        if v is not None:
+            return int(v), "tuned_configs.json"
+        return dict.__getitem__(self, workload), "hand"
+
+    def __getitem__(self, workload):
+        return self.source(workload)[0]
+
+
+PRODUCTION_K = _ProductionK(_HAND_TUNED_K)
 
 
 def _toolchain():
@@ -216,7 +244,8 @@ def _toolchain():
 
 def _measure(model, batch: int, windows: int = 6, iters: int = 32,
              x=None, y=None, criterion=None, units_per_step=None,
-             compute_dtype=None, fuse_k=None, warmup_windows: int = 0):
+             compute_dtype=None, fuse_k=None, warmup_windows: int = 0,
+             activation_memory=None):
     """Compile + run one training step.
 
     Default inputs are the ImageNet-shaped NHWC batch; recurrent/other
@@ -227,6 +256,14 @@ def _measure(model, batch: int, windows: int = 6, iters: int = 32,
     ``warmup_windows``: extra leading timing windows that run the full
     protocol (finite-loss assert included) but post no sample — the
     round-7 jitter fix for the short-step entries.
+
+    ``activation_memory``: the remat slice of the driver's
+    ``set_activation_memory`` policies — ``None``/``"none"`` (store
+    everything), ``"dots"`` (save matmul outputs, recompute the
+    elementwise chain) or ``"full"`` (save step inputs only), applied
+    with the SAME ``jax.checkpoint`` policies the optimizer uses so
+    autotuner trials measure the real knob.  The bf16 storage variants
+    are expressed through ``compute_dtype`` here, not this arg.
 
     ``fuse_k``: fuse ``K`` consecutive steps into one jit dispatch via
     ``lax.scan`` over a K-stacked input — the bench-side mirror of the
@@ -264,6 +301,17 @@ def _measure(model, batch: int, windows: int = 6, iters: int = 32,
 
     base_loss = mixed_precision_loss_fn(model, criterion,
                                         compute_dtype or jnp.bfloat16)
+    if activation_memory not in (None, "none"):
+        if activation_memory not in ("dots", "full"):
+            raise ValueError(
+                f"activation_memory must be None|'none'|'dots'|'full' "
+                f"here (bf16 storage rides compute_dtype), got "
+                f"{activation_memory!r}")
+        base_loss = jax.checkpoint(
+            base_loss,
+            policy=(jax.checkpoint_policies.dots_saveable
+                    if activation_memory == "dots"
+                    else jax.checkpoint_policies.nothing_saveable))
     grad_fn = jax.value_and_grad(base_loss, has_aux=True)
     rng0 = jax.random.PRNGKey(42)  # dropout rng (Inception-v1 trains one)
 
@@ -403,6 +451,32 @@ def _stats(samples):
         trimmed = sorted(samples)[1:-1]
         out["trimmed_median"] = round(statistics.median(trimmed), 1)
     return med, out
+
+
+UNSTEADY_TOL = 0.15  # relative deviation from the reference window rate
+
+
+def steady_windows(samples, tol=UNSTEADY_TOL, min_samples=3):
+    """The PR 6 steady-state window filter, shared by ``scaling_child``
+    and ``tools/autotune.py`` (ONE implementation so the two exclusion
+    accountings stay comparable): reference = trimmed median (single
+    best/worst window dropped) at >= 3 samples, plain median below;
+    kept = samples within ``tol`` relative deviation of the reference.
+
+    Returns ``(kept, excluded, ref)``.  ``excluded`` is counted even
+    when NOTHING survives — callers then score on ``ref``, never on a
+    silently-unfiltered set.  Below ``min_samples`` the filter does not
+    act (excluded = 0: one or two windows carry no spread to reason
+    about; the autotuner raises this to 4 because its early rungs
+    accumulate one window at a time)."""
+    samples = list(samples)
+    if len(samples) < min_samples:
+        return samples, 0, (statistics.median(samples) if samples
+                            else 0.0)
+    ref = statistics.median(sorted(samples)[1:-1]) if len(samples) >= 3 \
+        else statistics.median(samples)
+    kept = [s for s in samples if abs(s - ref) <= tol * ref]
+    return kept, len(samples) - len(kept), ref
 
 
 def _bottleneck(ca, ips, batch, peak=PEAK_BF16_FLOPS):
@@ -929,8 +1003,15 @@ def main(argv):
         if base_v and fused_v:
             dof[name_] = round(1.0 - base_v / fused_v, 4)
     out["dispatch_overhead_fraction"] = dof if dof else None
-    out["dispatch_fuse_k"] = {w: PRODUCTION_K[w]
-                              for w in ("ptb_lstm", "wide_deep")}
+    # dispatch_fuse_k_source (round-11): where each workload's fused-K
+    # came from — the autotuned tuned_configs.json entry for this
+    # backend, or the hand-maintained round-7 dict the shim falls back
+    # to (bench.PRODUCTION_K deprecation shim).
+    fuse_src = {w: PRODUCTION_K.source(w)
+                for w in ("ptb_lstm", "wide_deep")}
+    out["dispatch_fuse_k"] = {w: k for w, (k, _) in fuse_src.items()}
+    out["dispatch_fuse_k_source"] = {w: s
+                                     for w, (_, s) in fuse_src.items()}
 
     if not smoke:
         co = _collective_overhead()
@@ -1008,7 +1089,7 @@ def scaling_child():
     # super-linear "scaling").  The excluded fraction is REPORTED, not
     # hidden: a box that can't produce steady windows shows it.
     from bigdl_tpu.telemetry import Tracer
-    WARM_WINDOWS, UNSTEADY_TOL = 2, 0.15
+    WARM_WINDOWS = 2
     tracer = Tracer(enabled=True)
     iters = 10
     for w in range(WARM_WINDOWS + 6):
@@ -1026,14 +1107,10 @@ def scaling_child():
     spans = [(e[6]["rate"], e[6]["warmup"]) for e in tracer.events()
              if e[1] == "window"]
     steady = [r for r, warm in spans if not warm]
-    ref = statistics.median(sorted(steady)[1:-1]) if len(steady) >= 3 \
-        else statistics.median(steady)
-    kept = [r for r in steady
-            if abs(r - ref) / ref <= UNSTEADY_TOL]
     # excluded_fraction is over the STEADY CANDIDATES only — warmup
     # windows are excluded by design on every run and would put a
     # constant floor under the "couldn't hold steady" signal
-    excluded = len(steady) - len(kept)
+    kept, excluded, ref = steady_windows(steady)
     print(json.dumps({
         "ips": statistics.median(kept) if kept else ref,
         "windows_total": len(spans),

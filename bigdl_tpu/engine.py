@@ -29,16 +29,6 @@ def _default_retry_times() -> int:
     return get_config().failure_retry_times
 
 
-def _default_steps_per_dispatch() -> int:
-    from bigdl_tpu.utils.config import get_config
-    return get_config().steps_per_dispatch
-
-
-def _default_kernel_impl() -> str:
-    from bigdl_tpu.utils.config import get_config
-    return get_config().kernel_impl
-
-
 @dataclass
 class _EngineState:
     initialized: bool = False
@@ -48,16 +38,19 @@ class _EngineState:
     # loop); default flows from the unified typed config
     # (utils/config.Config.failure_retry_times, env BIGDL_TPU_*)
     failure_retry_times: int = field(default_factory=_default_retry_times)
-    # K-step dispatch fusion for the training driver loop (config
-    # steps_per_dispatch / env BIGDL_TPU_STEPS_PER_DISPATCH); optimizers
-    # resolve it here unless overridden per-run via
-    # Optimizer.set_steps_per_dispatch
-    steps_per_dispatch: int = field(
-        default_factory=_default_steps_per_dispatch)
+    # K-step dispatch fusion for the training driver loop.  None =
+    # never explicitly set at the Engine level: steps_per_dispatch()
+    # then resolves through the default chain (configure()/env >
+    # tuned_configs.json for the workload > Config dataclass default);
+    # Engine.set_steps_per_dispatch pins an explicit process-wide value
+    steps_per_dispatch: Optional[int] = None
     # custom-kernel selection (ops/pallas_*.py): "auto" | "pallas" |
-    # "xla", default from Config.kernel_impl / BIGDL_TPU_KERNEL_IMPL;
-    # layers resolve it here unless given a per-layer ``impl=`` override
-    kernel_impl: str = field(default_factory=_default_kernel_impl)
+    # "xla"; None = unset, resolved through the same default chain
+    kernel_impl: Optional[str] = None
+    # process-wide workload tag (Engine.set_workload): the key tuned
+    # defaults are looked up under when a call site doesn't carry its
+    # own tag (layer construction resolving kernel_impl, for example)
+    workload: Optional[str] = None
     # whether Engine.set_xla_async_collectives has armed the XLA
     # latency-hiding scheduler flags (None = never touched)
     xla_async_collectives: Optional[bool] = None
@@ -87,6 +80,13 @@ class Engine:
     @classmethod
     def reset(cls) -> None:
         cls._state = _EngineState()
+        # the tuned-config cache is process state the Engine owns the
+        # lifecycle of: a reset must also forget any loaded
+        # tuned_configs.json so tests and multi-run processes cannot
+        # leak a prior workload's tuned defaults (regression-gated in
+        # tests/test_autotune.py)
+        from bigdl_tpu.utils import tuned
+        tuned.reset_cache()
 
     # -- topology ----------------------------------------------------------
     @classmethod
@@ -128,9 +128,40 @@ class Engine:
         return cls._state.seed
 
     @classmethod
-    def steps_per_dispatch(cls) -> int:
-        """How many train steps the driver fuses into one jit dispatch."""
-        return max(1, int(cls._state.steps_per_dispatch))
+    def set_workload(cls, tag: Optional[str]) -> None:
+        """Tag the process-wide workload (``"ptb_lstm"``,
+        ``"wide_deep"``, …) so tuned defaults from
+        ``tuned_configs.json`` apply at call sites that don't carry
+        their own tag — layer construction resolving ``kernel_impl``,
+        for example.  ``None`` clears the tag.  Per-run tags
+        (``Optimizer.set_workload``, ``InferenceService(workload=)``)
+        take precedence over this one at their own call sites."""
+        cls._state.workload = tag
+
+    @classmethod
+    def workload(cls) -> Optional[str]:
+        return cls._state.workload
+
+    @classmethod
+    def _resolve(cls, knob: str, workload: Optional[str]):
+        """Default chain below the Engine-level setters: configure()/
+        env > tuned_configs.json (``workload@backend``) > dataclass
+        default (utils/tuned.resolve_default)."""
+        from bigdl_tpu.utils.tuned import resolve_default
+        wl = workload if workload is not None else cls._state.workload
+        value, _src = resolve_default(knob, workload=wl)
+        return value
+
+    @classmethod
+    def steps_per_dispatch(cls, workload: Optional[str] = None) -> int:
+        """How many train steps the driver fuses into one jit dispatch.
+        Resolution: :meth:`set_steps_per_dispatch` (explicit,
+        process-wide) > ``configure()``/``BIGDL_TPU_STEPS_PER_DISPATCH``
+        > tuned_configs.json for ``workload`` (or the process-wide
+        :meth:`workload` tag) > ``Config.steps_per_dispatch``."""
+        if cls._state.steps_per_dispatch is not None:
+            return max(1, int(cls._state.steps_per_dispatch))
+        return max(1, int(cls._resolve("steps_per_dispatch", workload)))
 
     @classmethod
     def set_steps_per_dispatch(cls, k: int) -> None:
@@ -139,12 +170,19 @@ class Engine:
         cls._state.steps_per_dispatch = int(k)
 
     @classmethod
-    def kernel_impl(cls) -> str:
+    def kernel_impl(cls, workload: Optional[str] = None) -> str:
         """Process-wide custom-kernel choice (``auto|pallas|xla``) the
         pallas-backed layers resolve when built without an explicit
         ``impl=``; see ``Config.kernel_impl`` for the semantics and
-        ``ops.resolve_kernel_impl`` for the auto rule."""
-        return cls._state.kernel_impl
+        ``ops.resolve_kernel_impl`` for the auto rule.  Same default
+        chain as :meth:`steps_per_dispatch`."""
+        if cls._state.kernel_impl is not None:
+            return cls._state.kernel_impl
+        impl = cls._resolve("kernel_impl", workload)
+        if impl not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"kernel_impl must be auto|pallas|xla, got {impl!r}")
+        return impl
 
     @classmethod
     def set_kernel_impl(cls, impl: str) -> None:
@@ -155,17 +193,21 @@ class Engine:
 
     # -- serving -----------------------------------------------------------
     @classmethod
-    def serving_defaults(cls) -> dict:
+    def serving_defaults(cls, workload: Optional[str] = None) -> dict:
         """Process-wide defaults for :class:`bigdl_tpu.serving.
         InferenceService` knobs (config ``serving_*`` fields /
-        ``BIGDL_TPU_SERVING_*`` env); per-service constructor args
-        override."""
-        from bigdl_tpu.utils.config import get_config
-        cfg = get_config()
+        ``BIGDL_TPU_SERVING_*`` env, each below a tuned_configs.json
+        entry for ``workload``); per-service constructor args
+        override.  ``row_buckets`` is the parsed-ready bucket spec
+        string (``serving_row_buckets``; "" = power-of-two auto)."""
         return {
-            "max_batch_size": cfg.serving_max_batch_size,
-            "batch_timeout_ms": cfg.serving_batch_timeout_ms,
-            "queue_capacity": cfg.serving_queue_capacity,
+            "max_batch_size": cls._resolve("serving_max_batch_size",
+                                           workload),
+            "batch_timeout_ms": cls._resolve("serving_batch_timeout_ms",
+                                             workload),
+            "queue_capacity": cls._resolve("serving_queue_capacity",
+                                           workload),
+            "row_buckets": cls._resolve("serving_row_buckets", workload),
         }
 
     # -- XLA collective scheduling ----------------------------------------

@@ -15,20 +15,24 @@ and grows with it.
 from bigdl_tpu.ops.registry import OPS, register_op, get_op
 
 
-def resolve_kernel_impl(override=None) -> str:
+def resolve_kernel_impl(override=None, workload=None) -> str:
     """Resolve the effective custom-kernel backend: ``"pallas"`` or
     ``"xla"``.
 
     Per-layer ``impl=`` override wins; otherwise ``Engine.kernel_impl()``
-    (``Config.kernel_impl`` / ``BIGDL_TPU_KERNEL_IMPL``).  ``"auto"``
-    means pallas-if-supported on a TPU backend and xla elsewhere —
-    interpret-mode kernels are correctness emulation, not a speedup, so
-    auto never engages them on CPU hosts (force with ``"pallas"``,
-    which tests and the bench entries do).  Runs at trace time on the
-    host — the choice is static per compiled program, one more knob
-    the autotuner can sweep (ROADMAP item 3)."""
+    (explicit ``Engine.set_kernel_impl`` > ``Config.kernel_impl`` /
+    ``BIGDL_TPU_KERNEL_IMPL`` > a ``tuned_configs.json`` entry for
+    ``workload`` — or the process-wide ``Engine.set_workload`` tag —
+    > the dataclass default).  ``"auto"`` means pallas-if-supported on
+    a TPU backend and xla elsewhere — interpret-mode kernels are
+    correctness emulation, not a speedup, so auto never engages them on
+    CPU hosts (force with ``"pallas"``, which tests and the bench
+    entries do).  Runs at trace time on the host — the choice is
+    static per compiled program, one more knob the autotuner sweeps
+    (tools/autotune.py)."""
     from bigdl_tpu.engine import Engine
-    impl = override if override is not None else Engine.kernel_impl()
+    impl = override if override is not None \
+        else Engine.kernel_impl(workload=workload)
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(
             f"kernel impl must be auto|pallas|xla, got {impl!r}")

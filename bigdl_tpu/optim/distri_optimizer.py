@@ -58,7 +58,6 @@ from bigdl_tpu.checkpoint import build_schema
 from bigdl_tpu.engine import Engine
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.parallel import grad_sync
-from bigdl_tpu.utils.config import get_config
 
 logger = logging.getLogger("bigdl_tpu.optim")
 
@@ -149,7 +148,6 @@ class DistriOptimizer(Optimizer):
         build its static bucket plan.  Pure-DP only: tensor parallelism
         shards the params themselves, so the flat-bucket ZeRO-1 protocol
         does not apply (those runs keep the constraint-driven path)."""
-        cfg = get_config()
         pure_dp = (self.param_specs is None and "data" in mesh.axis_names
                    and all(mesh.shape[a] == 1 for a in mesh.axis_names
                            if a != "data"))
@@ -171,11 +169,19 @@ class DistriOptimizer(Optimizer):
                 "needs a structured clip spec — use "
                 "set_gradient_clipping_by_value/_by_l2_norm (or "
                 "grad_sync=False for a custom grad_clip callable)")
-        self._gs_wire = grad_sync.resolve_wire_dtype(
-            self.grad_wire_dtype or cfg.grad_wire_dtype)
+        # constructor args win; otherwise the default chain
+        # (configure()/env > tuned_configs.json for this run's workload
+        # tag > dataclass default — utils/tuned.resolve_default)
+        from bigdl_tpu.utils.tuned import resolve_default
+        wl = self.workload or Engine.workload()
+        wire = self.grad_wire_dtype if self.grad_wire_dtype is not None \
+            else resolve_default("grad_wire_dtype", workload=wl)[0]
+        bucket = self.grad_bucket_bytes \
+            if self.grad_bucket_bytes is not None \
+            else resolve_default("grad_bucket_bytes", workload=wl)[0]
+        self._gs_wire = grad_sync.resolve_wire_dtype(wire)
         self._gs_plan = grad_sync.build_plan(
-            params, mesh.shape["data"],
-            self.grad_bucket_bytes or cfg.grad_bucket_bytes)
+            params, mesh.shape["data"], int(bucket))
 
     def _check_resumed_opt_state(self, ostate) -> None:
         """Fail LOUDLY when a retry/resume checkpoint's opt_state was

@@ -163,6 +163,10 @@ class Optimizer:
         self.seed = 1
         # K-step dispatch fusion; None = Engine/config default
         self.steps_per_dispatch: Optional[int] = None
+        # workload tag (set_workload): the tuned_configs.json key this
+        # run's knob defaults resolve under; None = only the
+        # process-wide Engine.set_workload tag (if any) applies
+        self.workload: Optional[str] = None
 
         # driver state (reference: the state Table inside OptimMethod —
         # epoch/neval survive checkpoint/resume)
@@ -179,9 +183,11 @@ class Optimizer:
         self._eval_fwd = None  # cached jit'd eval forward
         self._resume_opt_state = None  # optimizer state restored on retry
         self.compute_dtype = None  # None = full f32; jnp.bfloat16 for MXU
-        # activation-memory policy (set_activation_memory): None/"none"
-        # = inert (bitwise-identical driver), else remat and/or bf16
-        # activation storage for HBM-bound workloads
+        # activation-memory policy (set_activation_memory): "none" =
+        # inert (bitwise-identical driver), else remat and/or bf16
+        # activation storage for HBM-bound workloads.  None = setter
+        # never called — resolved through the default chain (env/tuned
+        # entry may apply; _resolved_activation_memory)
         self.activation_memory: Optional[str] = None
         self._dispatch_count = 0  # jit dispatches issued (observability)
         self._stager: Optional[DeviceBlockStager] = None
@@ -345,7 +351,11 @@ class Optimizer:
             raise ValueError(
                 f"activation memory policy must be one of "
                 f"{self._ACTIVATION_POLICIES} or None, got {policy!r}")
-        self.activation_memory = policy
+        # an explicit None IS the inert policy, not "unset": it must
+        # override an env/tuned default the same way "none" does
+        # (self.activation_memory stays None only when this setter was
+        # never called — the one state the default chain may fill)
+        self.activation_memory = "none" if policy is None else policy
         return self
 
     def set_steps_per_dispatch(self, k: int) -> "Optimizer":
@@ -357,6 +367,25 @@ class Optimizer:
         if int(k) < 1:
             raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
         self.steps_per_dispatch = int(k)
+        return self
+
+    def set_workload(self, tag: Optional[str]) -> "Optimizer":
+        """Tag this run's workload (``"ptb_lstm"``, ``"wide_deep"``, …)
+        so autotuned defaults from ``tuned_configs.json`` apply to any
+        knob still at its dataclass default: ``steps_per_dispatch``,
+        ``activation_memory`` and (DistriOptimizer) the grad-sync
+        wire/bucket knobs resolve through
+
+            explicit setter > ``BIGDL_TPU_*`` env >
+            tuned_configs.json[``tag@backend``] > dataclass default
+
+        (``utils/tuned.resolve_default``).  With no tuned entry for the
+        tag — or no tuned file at all — tagging is provably inert
+        (bitwise loss sequence, equal dispatch count; gated in
+        tests/test_autotune.py).  ``kernel_impl`` is resolved at MODEL
+        construction, before an optimizer exists — use
+        ``Engine.set_workload`` for that knob."""
+        self.workload = tag
         return self
 
     def set_telemetry(self, enabled: bool = True,
@@ -395,9 +424,27 @@ class Optimizer:
         raise NotImplementedError
 
     # ------------------------------------------------------------- shared
+    def _resolved_activation_memory(self) -> str:
+        """Per-run ``set_activation_memory`` wins; otherwise the
+        default chain (``configure()``/``BIGDL_TPU_ACTIVATION_MEMORY``
+        > tuned entry for this run's workload tag > ``"none"``).  A
+        garbage value arriving through env or a tuned file fails
+        loudly here, same as the setter would."""
+        if self.activation_memory is not None:
+            return self.activation_memory
+        from bigdl_tpu.utils.tuned import resolve_default
+        policy, src = resolve_default(
+            "activation_memory",
+            workload=self.workload or Engine.workload())
+        if policy not in self._ACTIVATION_POLICIES:
+            raise ValueError(
+                f"activation_memory {policy!r} (from {src}) must be "
+                f"one of {self._ACTIVATION_POLICIES}")
+        return policy
+
     def _loss_and_grad_fn(self):
         model, criterion = self.model, self.criterion
-        policy = self.activation_memory or "none"
+        policy = self._resolved_activation_memory()
         compute_dtype = self.compute_dtype
         if policy.startswith("bf16"):
             if compute_dtype is not None and compute_dtype != jnp.bfloat16:
@@ -405,7 +452,7 @@ class Optimizer:
                 # storage downcast: an explicit non-bf16 compute dtype
                 # contradicts a bf16 activation policy
                 raise ValueError(
-                    f"set_activation_memory({self.activation_memory!r}) "
+                    f"activation memory policy {policy!r} "
                     f"conflicts with set_compute_dtype({compute_dtype}) "
                     f"— bf16 activation storage IS bf16 compute; drop "
                     f"one of the two settings")
@@ -663,7 +710,8 @@ class Optimizer:
         fusion/pipelining design).  Returns the final (params, mstate,
         ostate) bindings."""
         state = self.state
-        k_max = self.steps_per_dispatch or Engine.steps_per_dispatch()
+        k_max = self.steps_per_dispatch \
+            or Engine.steps_per_dispatch(workload=self.workload)
         k_max = max(1, int(k_max))
         scale = self._records_scale()
         # telemetry: resolve the enable knob (per-run override → config),

@@ -56,6 +56,42 @@ def row_buckets(max_batch_size: int) -> Tuple[int, ...]:
     return tuple(bs)
 
 
+def parse_row_buckets(spec: str, max_batch_size: int) -> Tuple[int, ...]:
+    """Parse a ``Config.serving_row_buckets`` bucket-set spec:
+
+    - ``""`` / ``"pow2"`` — :func:`row_buckets` power-of-two auto (the
+      default);
+    - ``"top"`` — one bucket at ``max_batch_size`` (maximum executable
+      sharing, maximum padding — the autotuner's coarse-granularity
+      grid point);
+    - ``"8,16,32"`` — explicit ascending positive ints whose top must
+      cover ``max_batch_size`` (a full coalesced batch always has a
+      bucket to pad into).
+    """
+    s = (spec or "").strip()
+    if s in ("", "pow2"):
+        return row_buckets(max_batch_size)
+    if s == "top":
+        return (max_batch_size,)
+    try:
+        buckets = tuple(int(tok) for tok in s.split(","))
+    except ValueError:
+        raise ValueError(
+            f"row-bucket spec {spec!r} must be '', 'pow2', 'top' or a "
+            f"comma-separated int list") from None
+    if (not buckets or any(b < 1 for b in buckets)
+            or list(buckets) != sorted(set(buckets))):
+        raise ValueError(
+            f"row buckets {buckets} must be ascending unique positive "
+            f"ints")
+    if buckets[-1] < max_batch_size:
+        raise ValueError(
+            f"top row bucket {buckets[-1]} < max_batch_size "
+            f"{max_batch_size} — a full coalesced batch would have no "
+            f"bucket to pad into")
+    return buckets
+
+
 def leading_rows(x) -> int:
     leaves = _tree.tree_leaves(x)
     if not leaves:
@@ -99,10 +135,19 @@ class InferenceService:
         construction (deploy-time warmup); when ``None``, the spec is
         captured from the first request and warmup happens then (the
         back-compat ``PredictionService`` path).
-    max_batch_size / batch_timeout_ms / queue_capacity:
+    max_batch_size / batch_timeout_ms / queue_capacity / buckets:
         Coalescing and backpressure knobs; ``None`` resolves from
-        ``Engine.serving_defaults()`` (config ``serving_*`` fields /
-        ``BIGDL_TPU_SERVING_*`` env).
+        ``Engine.serving_defaults(workload)`` (config ``serving_*``
+        fields / ``BIGDL_TPU_SERVING_*`` env, each sitting above a
+        ``tuned_configs.json`` entry for ``workload`` and the
+        dataclass default — the documented resolution chain).
+        ``buckets`` is either an explicit ascending int tuple or a
+        :func:`parse_row_buckets` spec string ("pow2" / "top" /
+        "8,16,32").
+    workload:
+        Tuned-config key this service's knob defaults resolve under
+        (e.g. the tag ``tools/autotune.py --workload`` tuned).  None =
+        config/env/dataclass defaults only.
     start:
         ``start=False`` builds the service with the batcher parked —
         requests queue (bounded) until :meth:`start`.  Used by tests to
@@ -114,9 +159,11 @@ class InferenceService:
                  input_spec=None, max_batch_size: Optional[int] = None,
                  batch_timeout_ms: Optional[float] = None,
                  queue_capacity: Optional[int] = None,
+                 buckets=None, workload: Optional[str] = None,
                  name: str = "model", start: bool = True):
         from bigdl_tpu.engine import Engine
-        defaults = Engine.serving_defaults()
+        self.workload = workload
+        defaults = Engine.serving_defaults(workload)
         self.model = model
         if params is None:
             model._ensure_init()
@@ -135,7 +182,17 @@ class InferenceService:
         self.queue_capacity = int(
             queue_capacity if queue_capacity is not None
             else defaults["queue_capacity"])
-        self.buckets = row_buckets(self.max_batch_size)
+        if buckets is None:
+            buckets = defaults.get("row_buckets", "")
+        if isinstance(buckets, str):
+            self.buckets = parse_row_buckets(buckets, self.max_batch_size)
+        else:
+            # explicit tuple takes the same validation path: round-trip
+            # through the spec grammar so ad-hoc bucket sets obey the
+            # ascending/top-covers-max invariants too
+            self.buckets = parse_row_buckets(
+                ",".join(str(int(b)) for b in buckets),
+                self.max_batch_size)
 
         # the ONE jit for this model; bucket executables are AOT builds
         # of it.  _trace_count counts Python traces — after warmup it

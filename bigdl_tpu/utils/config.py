@@ -7,8 +7,14 @@ Reference: the ``bigdl.*`` Java system properties scattered across
 + per-example scopt parsers.  SURVEY §5 flags the lack of one typed
 config object as a thing for the new build to centralize — this is it.
 
-Resolution order (later wins): dataclass defaults → ``BIGDL_TPU_*``
-environment variables → explicit ``configure(**kw)`` calls.
+Resolution order (later wins): dataclass defaults → per-workload
+``tuned_configs.json`` entries (autotuner output, consumed through
+``utils/tuned.resolve_default`` — only where a call site supplies a
+workload tag) → ``BIGDL_TPU_*`` environment variables → explicit
+``configure(**kw)`` calls.  The config records WHERE each field's value
+came from (``Config.source``: "default" | "env" | "explicit") so the
+tuned layer can slot in below env without guessing — a field that still
+carries its dataclass default is the only place a tuned value may apply.
 """
 
 from __future__ import annotations
@@ -81,6 +87,19 @@ class Config:
     # inherits kernel choice as one more measured knob.  Env:
     # BIGDL_TPU_KERNEL_IMPL.  Per-layer ``impl=`` constructor args win.
     kernel_impl: str = "auto"
+    # activation-memory policy default (Optimizer.set_activation_memory
+    # overrides per run): "none" | "dots" | "full" | "bf16" |
+    # "bf16+dots" | "bf16+full" — remat / bf16 activation storage for
+    # HBM-bound workloads (see optim/optimizer.py for the semantics).
+    # One more autotuner knob: tuned_configs.json can set it per
+    # workload.  Env: BIGDL_TPU_ACTIVATION_MEMORY.
+    activation_memory: str = "none"
+    # serving row-bucket set: "" or "pow2" = power-of-two buckets up to
+    # serving_max_batch_size (serving.row_buckets — the default);
+    # "top" = one bucket at max_batch_size (max executable sharing, max
+    # padding); "8,16,32" = explicit ascending list whose top must be
+    # >= serving_max_batch_size.  Parsed by serving.parse_row_buckets.
+    serving_row_buckets: str = ""
     # numerics
     compute_dtype: str = "float32"     # "bfloat16" flips matmul precision
     matmul_precision: str = "default"  # jax "default"|"high"|"highest"
@@ -107,6 +126,17 @@ class Config:
     mesh_model: int = 1
     mesh_seq: int = 1
     mesh_pipe: int = 1
+    # provenance: field name -> "env" | "explicit" for every field that
+    # was overridden; absent = still the dataclass default (the one
+    # state where a tuned_configs.json value may apply — see
+    # utils/tuned.resolve_default).  Private: not an env-settable knob.
+    _sources: dict = dataclasses.field(default_factory=dict, repr=False,
+                                       compare=False)
+
+    def source(self, name: str) -> str:
+        """Where ``name``'s current value came from: ``"default"`` |
+        ``"env"`` | ``"explicit"``."""
+        return self._sources.get(name, "default")
 
     @staticmethod
     def _coerce(value: str, typ):
@@ -118,17 +148,21 @@ class Config:
     def from_env(cls) -> "Config":
         cfg = cls()
         for f in dataclasses.fields(cls):
+            if f.name.startswith("_"):
+                continue  # bookkeeping, not a knob
             env = _ENV_PREFIX + f.name.upper()
             if env in os.environ:
                 setattr(cfg, f.name,
                         cls._coerce(os.environ[env], type(getattr(cfg,
                                                                   f.name))))
+                cfg._sources[f.name] = "env"
         # short alias: BIGDL_TPU_TELEMETRY=1 ⇔ BIGDL_TPU_TELEMETRY_ENABLED=1
         # (the explicit long form wins when both are set)
         alias = _ENV_PREFIX + "TELEMETRY"
         if alias in os.environ and \
                 _ENV_PREFIX + "TELEMETRY_ENABLED" not in os.environ:
             cfg.telemetry_enabled = cls._coerce(os.environ[alias], bool)
+            cfg._sources["telemetry_enabled"] = "env"
         return cfg
 
 
@@ -150,10 +184,13 @@ def configure(**kw) -> Config:
     """Override config fields programmatically (highest precedence)."""
     cfg = get_config()
     for k, v in kw.items():
-        if not hasattr(cfg, k):
-            raise AttributeError(f"unknown config field {k!r}; fields: "
-                                 f"{[f.name for f in dataclasses.fields(Config)]}")
+        if k.startswith("_") or not hasattr(cfg, k):
+            names = [f.name for f in dataclasses.fields(Config)
+                     if not f.name.startswith("_")]
+            raise AttributeError(
+                f"unknown config field {k!r}; fields: {names}")
         setattr(cfg, k, v)
+        cfg._sources[k] = "explicit"
     if "debug_nans" in kw:
         apply_debug_config(cfg)
     return cfg

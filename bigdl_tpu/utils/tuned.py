@@ -1,0 +1,217 @@
+"""Tuned-config layer — the autotuner's output consumed as defaults.
+
+``tools/autotune.py`` (ROADMAP item 3, in the spirit of TVM
+arXiv:1802.04799 / Learning to Optimize Tensor Programs
+arXiv:1805.08166) writes per-workload best configs plus measurement
+provenance to a checked-in, schema-versioned ``tuned_configs.json``.
+This module is the CONSUMPTION side: ``Engine``/``Optimizer``/
+``InferenceService`` resolve knob defaults through
+:func:`resolve_default`, which implements the documented precedence
+
+    explicit setter (``configure()`` / ``Engine.set_*`` / per-run
+    builder) > ``BIGDL_TPU_*`` env var > ``tuned_configs.json`` entry
+    (keyed by ``workload@backend``) > dataclass default
+
+so a tuned value only ever fills a slot the user left at its dataclass
+default — it can never override an explicit choice or an env var.
+
+Failure contract (gated in tests/test_autotune.py):
+
+- **Absent or empty file is provably inert**: no entries, no warning —
+  every lookup returns None and the chain falls through to the
+  dataclass default (bitwise-identical training, the established
+  inertness-gate pattern).
+- **Malformed / stale-schema files are rejected LOUDLY**: one
+  ``logging.error`` naming the file and the reason, then the ENTIRE
+  tuned layer is skipped (never a partial read — a file wrong in one
+  place is not trusted anywhere else).
+- Entries may only reference knobs that exist on
+  :class:`~bigdl_tpu.utils.config.Config` (same-typed values); the
+  checked-in file is additionally round-trip-gated in tier-1.
+
+The parsed file is cached process-wide; ``Engine.reset()`` clears the
+cache (so tests and multi-run processes cannot leak a prior workload's
+tuned defaults — see :func:`reset_cache`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Optional, Tuple
+
+from bigdl_tpu.utils.config import Config, get_config
+
+logger = logging.getLogger("bigdl_tpu.tuned")
+
+SCHEMA_VERSION = 1
+ENV_PATH = "BIGDL_TPU_TUNED_CONFIGS"
+
+# cache states: None = not loaded yet; dict = validated entries (empty
+# when the file is absent, empty, or was rejected)
+_entries: Optional[dict] = None
+
+
+class TunedConfigError(ValueError):
+    """A tuned_configs.json that cannot be trusted (wrong schema
+    version, unknown knobs, type drift, structural damage)."""
+
+
+def default_path() -> str:
+    """``$BIGDL_TPU_TUNED_CONFIGS`` when set, else the checked-in
+    ``tuned_configs.json`` at the repository root (the directory that
+    holds the ``bigdl_tpu`` package)."""
+    env = os.environ.get(ENV_PATH)
+    if env:
+        return env
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(pkg_root, "tuned_configs.json")
+
+
+def _knob_types() -> dict:
+    """Config field name -> dataclass default (the type authority)."""
+    return {f.name: getattr(Config(), f.name)
+            for f in dataclasses.fields(Config)
+            if not f.name.startswith("_")}
+
+
+def _type_ok(default, value) -> bool:
+    """Same-typed as the Config default.  bool is NOT an int here
+    (bool subclasses int in Python — a tuned ``true`` must not slip
+    into an int knob), and ints are acceptable floats."""
+    if isinstance(default, bool) or isinstance(value, bool):
+        return isinstance(default, bool) and isinstance(value, bool)
+    if isinstance(default, float):
+        return isinstance(value, (int, float))
+    return isinstance(value, type(default))
+
+
+def validate_document(doc) -> dict:
+    """Validate a parsed tuned-configs document; returns its entries
+    dict or raises :class:`TunedConfigError` listing what is wrong.
+    The whole file is rejected on the first problem — a partially
+    trusted tuning file is worse than none."""
+    if not isinstance(doc, dict):
+        raise TunedConfigError(
+            f"top level must be an object, got {type(doc).__name__}")
+    version = doc.get("schema_version")
+    if version != SCHEMA_VERSION:
+        raise TunedConfigError(
+            f"schema_version {version!r} != supported {SCHEMA_VERSION} "
+            f"— stale or future file; re-run tools/autotune.py")
+    entries = doc.get("entries")
+    if not isinstance(entries, dict):
+        raise TunedConfigError("'entries' must be an object")
+    knobs = _knob_types()
+    for key, entry in entries.items():
+        if not isinstance(entry, dict):
+            raise TunedConfigError(f"entry {key!r} must be an object")
+        workload = entry.get("workload")
+        backend = entry.get("backend")
+        if (not isinstance(workload, str) or not isinstance(backend, str)
+                or key != f"{workload}@{backend}"):
+            raise TunedConfigError(
+                f"entry key {key!r} must equal '<workload>@<backend>' "
+                f"and match its workload={workload!r} backend="
+                f"{backend!r} fields")
+        best = entry.get("best")
+        if not isinstance(best, dict) or not best:
+            raise TunedConfigError(
+                f"entry {key!r}: 'best' must be a non-empty object")
+        for knob, value in best.items():
+            if knob not in knobs:
+                raise TunedConfigError(
+                    f"entry {key!r}: unknown knob {knob!r} — tuned "
+                    f"knobs must exist on Config")
+            if not _type_ok(knobs[knob], value):
+                raise TunedConfigError(
+                    f"entry {key!r}: knob {knob!r} value {value!r} "
+                    f"({type(value).__name__}) does not match the "
+                    f"Config field type "
+                    f"({type(knobs[knob]).__name__})")
+        if not isinstance(entry.get("provenance"), dict):
+            raise TunedConfigError(
+                f"entry {key!r}: 'provenance' (toolchain stamp, "
+                f"windows, score) is required — unattributed tuning "
+                f"numbers are not trusted")
+    return entries
+
+
+def load(path: Optional[str] = None, force: bool = False) -> dict:
+    """Entries of the tuned-config file, validated and cached.
+    Absent/empty file → ``{}`` silently (inert); damaged file → ONE
+    loud ``logging.error`` and ``{}`` (tuned layer skipped)."""
+    global _entries
+    if _entries is not None and not force and path is None:
+        return _entries
+    p = path or default_path()
+    entries: dict = {}
+    if os.path.exists(p):
+        try:
+            with open(p, "r", encoding="utf-8") as fh:
+                text = fh.read()
+            if text.strip():
+                entries = validate_document(json.loads(text))
+        except (OSError, json.JSONDecodeError, TunedConfigError) as e:
+            logger.error(
+                "tuned_configs.json REJECTED — tuned-default layer "
+                "disabled for this process (%s: %s: %s).  Fix or "
+                "delete the file, or point %s elsewhere, then "
+                "Engine.reset() to reload.",
+                p, type(e).__name__, e, ENV_PATH)
+            entries = {}
+    if path is None:
+        _entries = entries
+    return entries
+
+
+def reset_cache() -> None:
+    """Drop the cached file so the next lookup re-reads (and
+    re-validates) it.  Called by ``Engine.reset()`` — the regression
+    gate for "a prior workload's tuned defaults cannot leak across
+    runs" lives in tests/test_autotune.py."""
+    global _entries
+    _entries = None
+
+
+def lookup(workload: str, knob: str,
+           backend: Optional[str] = None):
+    """Tuned value for ``knob`` under ``workload@backend``, or None.
+    ``backend`` defaults to the live ``jax.default_backend()`` — tuned
+    numbers are a property of the hardware they were measured on, so a
+    cpu-tuned entry never leaks onto a TPU run (and vice versa)."""
+    if not workload:
+        return None
+    entries = load()
+    if not entries:
+        return None
+    if backend is None:
+        import jax
+        backend = jax.default_backend()
+    entry = entries.get(f"{workload}@{backend}")
+    if entry is None:
+        return None
+    return entry["best"].get(knob)
+
+
+def resolve_default(knob: str, workload: Optional[str] = None,
+                    backend: Optional[str] = None) -> Tuple[object, str]:
+    """Resolve a knob through the documented default chain; returns
+    ``(value, source)`` with source one of ``"explicit"`` (a
+    ``configure()`` call), ``"env"`` (``BIGDL_TPU_*``), ``"tuned"``
+    (tuned_configs.json hit for ``workload@backend``) or
+    ``"default"`` (dataclass default).  Engine-level and per-run
+    setters sit ABOVE this function — their call sites short-circuit
+    before asking for a default."""
+    cfg = get_config()
+    src = cfg.source(knob)
+    if src != "default":
+        return getattr(cfg, knob), src
+    if workload:
+        v = lookup(workload, knob, backend=backend)
+        if v is not None:
+            return v, "tuned"
+    return getattr(cfg, knob), "default"

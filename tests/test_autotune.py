@@ -1,0 +1,674 @@
+"""Autotuner + tuned-config consumption tests (round-11, ISSUE-9).
+
+Covers the acceptance surface:
+- ``tuned_configs.json`` schema validation (stale/malformed files
+  rejected LOUDLY, whole-file, with the tuned layer skipped);
+- the checked-in repo file parses, round-trips, and references only
+  knobs that exist on ``Config`` (tier-1 schema gate);
+- the full default-resolution precedence chain — explicit setter >
+  ``BIGDL_TPU_*`` env > tuned entry for ``workload@backend`` >
+  dataclass default — for ``steps_per_dispatch``,
+  ``grad_wire_dtype`` and ``kernel_impl``;
+- ``Engine.reset()`` drops the cached tuned file (no cross-run leaks);
+- successive halving: deterministic given the same measurements
+  (tie-break = lexicographically smallest canonical config key), HARD
+  window budget with per-rung survivor counts logged, loud refusal
+  when the budget cannot rank the grid;
+- the end-to-end gate: ``tools.autotune --workload ptb_lstm --smoke``
+  writes a valid tuned file and a subsequent ``Optimizer`` run picks
+  up the tuned ``steps_per_dispatch`` through the resolution chain
+  (dispatch-counted, not hand-checked);
+- inertness: tagging a workload with no tuned entry (absent OR empty
+  file) is bitwise inert — same loss sequence, same dispatch count;
+- ``bench.PRODUCTION_K`` deprecation shim source attribution.
+"""
+
+import json
+import logging
+import math
+import os
+
+import numpy as np
+import pytest
+
+import bench
+import tools.autotune as autotune
+from bigdl_tpu import nn, optim
+from bigdl_tpu.engine import Engine
+from bigdl_tpu.utils import tuned
+from bigdl_tpu.utils.config import Config, configure, reset_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch, tmp_path):
+    """Every test starts with a fresh config, a fresh Engine and the
+    tuned layer pointed at an ABSENT file, so the repo's checked-in
+    tuned_configs.json (and any ambient env) cannot leak in."""
+    monkeypatch.setenv(tuned.ENV_PATH, str(tmp_path / "absent.json"))
+    reset_config()
+    Engine.reset()
+    yield
+    reset_config()
+    Engine.reset()
+
+
+def make_entry(workload="ptb_lstm", backend="cpu", best=None, prov=None):
+    return {"workload": workload, "backend": backend,
+            "best": dict(best if best is not None
+                         else {"steps_per_dispatch": 3}),
+            "provenance": dict(prov if prov is not None
+                               else {"toolchain": {}, "score": 1.0})}
+
+
+def write_doc(path, entries, version=tuned.SCHEMA_VERSION):
+    path.write_text(json.dumps(
+        {"schema_version": version, "entries": entries}))
+    return path
+
+
+def use_file(monkeypatch, path):
+    """Point the tuned layer at ``path`` and drop every cache."""
+    monkeypatch.setenv(tuned.ENV_PATH, str(path))
+    Engine.reset()
+    reset_config()
+
+
+# ===========================================================================
+class TestSchemaValidation:
+    def test_valid_document_roundtrips(self):
+        doc = {"schema_version": 1,
+               "entries": {"ptb_lstm@cpu": make_entry()}}
+        entries = tuned.validate_document(doc)
+        assert entries["ptb_lstm@cpu"]["best"]["steps_per_dispatch"] == 3
+        assert json.loads(json.dumps(doc)) == doc
+
+    @pytest.mark.parametrize("version", [0, 2, None, "1"])
+    def test_wrong_schema_version_rejected(self, version):
+        with pytest.raises(tuned.TunedConfigError, match="schema_version"):
+            tuned.validate_document(
+                {"schema_version": version, "entries": {}})
+
+    @pytest.mark.parametrize("doc", [[], "x", 7, None])
+    def test_non_object_top_level_rejected(self, doc):
+        with pytest.raises(tuned.TunedConfigError):
+            tuned.validate_document(doc)
+
+    def test_unknown_knob_rejected(self):
+        doc = {"schema_version": 1, "entries": {"ptb_lstm@cpu": make_entry(
+            best={"no_such_knob": 1})}}
+        with pytest.raises(tuned.TunedConfigError, match="no_such_knob"):
+            tuned.validate_document(doc)
+
+    @pytest.mark.parametrize("best", [
+        {"steps_per_dispatch": "8"},     # str into int knob
+        {"steps_per_dispatch": True},    # bool must NOT pass as int
+        {"grad_wire_dtype": 16},         # int into str knob
+    ])
+    def test_type_drift_rejected(self, best):
+        doc = {"schema_version": 1,
+               "entries": {"ptb_lstm@cpu": make_entry(best=best)}}
+        with pytest.raises(tuned.TunedConfigError, match="type"):
+            tuned.validate_document(doc)
+
+    def test_float_knob_accepts_int(self):
+        doc = {"schema_version": 1, "entries": {"s@cpu": make_entry(
+            workload="s", best={"serving_batch_timeout_ms": 2})}}
+        assert tuned.validate_document(doc)
+
+    def test_key_workload_mismatch_rejected(self):
+        doc = {"schema_version": 1,
+               "entries": {"other@cpu": make_entry(workload="ptb_lstm")}}
+        with pytest.raises(tuned.TunedConfigError, match="key"):
+            tuned.validate_document(doc)
+
+    def test_missing_provenance_rejected(self):
+        e = make_entry()
+        del e["provenance"]
+        with pytest.raises(tuned.TunedConfigError, match="provenance"):
+            tuned.validate_document(
+                {"schema_version": 1, "entries": {"ptb_lstm@cpu": e}})
+
+    def test_empty_best_rejected(self):
+        with pytest.raises(tuned.TunedConfigError, match="best"):
+            tuned.validate_document(
+                {"schema_version": 1,
+                 "entries": {"ptb_lstm@cpu": make_entry(best={})}})
+
+
+# ===========================================================================
+class TestCheckedInFile:
+    """Tier-1 gate over the ACTUAL checked-in tuned_configs.json."""
+
+    PATH = os.path.join(REPO, "tuned_configs.json")
+
+    def test_checked_in_file_validates_and_roundtrips(self):
+        with open(self.PATH, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        doc = json.loads(text)
+        entries = tuned.validate_document(doc)  # knob/type gate inside
+        assert entries, "checked-in tuned_configs.json must ship non-empty"
+        assert json.loads(json.dumps(doc)) == doc
+        cfg_fields = {f.name for f in
+                      __import__("dataclasses").fields(Config)}
+        for key, e in entries.items():
+            assert set(e["best"]) <= cfg_fields, key
+            prov = e["provenance"]
+            # measurement provenance: auditable or it didn't happen
+            assert "toolchain" in prov and "rungs" in prov, key
+            assert prov["windows_total"] <= prov["budget"], key
+
+    def test_cpu_baseline_workloads_present(self):
+        with open(self.PATH, "r", encoding="utf-8") as fh:
+            entries = tuned.validate_document(json.load(fh))
+        assert "ptb_lstm@cpu" in entries
+        assert "wide_deep@cpu" in entries
+
+
+# ===========================================================================
+class TestResolutionChain:
+    """explicit setter > BIGDL_TPU_* env > tuned entry > dataclass
+    default, per knob (the documented order, utils/tuned docstring)."""
+
+    CASES = [
+        ("steps_per_dispatch", 3, "BIGDL_TPU_STEPS_PER_DISPATCH",
+         "5", 5, 7),
+        ("grad_wire_dtype", "bf16", "BIGDL_TPU_GRAD_WIRE_DTYPE",
+         "f16", "f16", "f32"),
+        ("kernel_impl", "xla", "BIGDL_TPU_KERNEL_IMPL",
+         "pallas", "pallas", "xla"),
+    ]
+
+    @pytest.mark.parametrize("knob,tv,env,envs,envv,expl", CASES)
+    def test_chain(self, monkeypatch, tmp_path, knob, tv, env, envs,
+                   envv, expl):
+        default = getattr(Config(), knob)
+        p = write_doc(tmp_path / "t.json",
+                      {"ptb_lstm@cpu": make_entry(best={knob: tv})})
+        use_file(monkeypatch, p)
+        # 1) no tag: dataclass default
+        assert tuned.resolve_default(knob) == (default, "default")
+        # 2) tagged: tuned beats default
+        assert tuned.resolve_default(knob, workload="ptb_lstm") == \
+            (tv, "tuned")
+        # 3) env beats tuned even when tagged
+        monkeypatch.setenv(env, envs)
+        reset_config()
+        assert tuned.resolve_default(knob, workload="ptb_lstm") == \
+            (envv, "env")
+        # 4) explicit configure() beats env
+        configure(**{knob: expl})
+        assert tuned.resolve_default(knob, workload="ptb_lstm") == \
+            (expl, "explicit")
+
+    def test_engine_steps_per_dispatch_chain(self, monkeypatch, tmp_path):
+        p = write_doc(tmp_path / "t.json", {"ptb_lstm@cpu": make_entry(
+            best={"steps_per_dispatch": 3})})
+        use_file(monkeypatch, p)
+        assert Engine.steps_per_dispatch() == 1
+        assert Engine.steps_per_dispatch(workload="ptb_lstm") == 3
+        # process-wide tag works where the call site carries none
+        Engine.set_workload("ptb_lstm")
+        assert Engine.steps_per_dispatch() == 3
+        Engine.set_workload(None)
+        # the explicit Engine setter tops everything
+        Engine.set_steps_per_dispatch(9)
+        assert Engine.steps_per_dispatch(workload="ptb_lstm") == 9
+
+    def test_engine_kernel_impl_chain(self, monkeypatch, tmp_path):
+        p = write_doc(tmp_path / "t.json", {"ptb_lstm@cpu": make_entry(
+            best={"kernel_impl": "xla"})})
+        use_file(monkeypatch, p)
+        assert Engine.kernel_impl() == "auto"
+        assert Engine.kernel_impl(workload="ptb_lstm") == "xla"
+        Engine.set_kernel_impl("pallas")
+        assert Engine.kernel_impl(workload="ptb_lstm") == "pallas"
+
+    def test_backend_keying_isolates_tuned_values(self, monkeypatch,
+                                                  tmp_path):
+        """A tpu-tuned entry must never leak onto a cpu run."""
+        p = write_doc(tmp_path / "t.json", {"ptb_lstm@tpu": make_entry(
+            backend="tpu", best={"steps_per_dispatch": 16})})
+        use_file(monkeypatch, p)
+        assert Engine.steps_per_dispatch(workload="ptb_lstm") == 1
+
+    def test_serving_defaults_pick_up_tuned_entry(self, monkeypatch,
+                                                  tmp_path):
+        p = write_doc(tmp_path / "t.json", {"serving_mlp@cpu": make_entry(
+            workload="serving_mlp",
+            best={"serving_max_batch_size": 16,
+                  "serving_batch_timeout_ms": 1.5,
+                  "serving_row_buckets": "top"})})
+        use_file(monkeypatch, p)
+        d = Engine.serving_defaults("serving_mlp")
+        assert d["max_batch_size"] == 16
+        assert d["batch_timeout_ms"] == 1.5
+        assert d["row_buckets"] == "top"
+        # untagged service sees plain config defaults
+        d0 = Engine.serving_defaults()
+        assert d0["max_batch_size"] == 32
+        assert d0["row_buckets"] == ""
+
+    def test_activation_memory_explicit_none_beats_tuned(
+            self, monkeypatch, tmp_path):
+        """set_activation_memory(None) is the documented INERT policy,
+        not 'unset': it must override a tuned/env value exactly like
+        'none' does (only a never-called setter lets the default chain
+        fill the knob)."""
+        p = write_doc(tmp_path / "t.json", {"ptb_lstm@cpu": make_entry(
+            best={"activation_memory": "dots"})})
+        use_file(monkeypatch, p)
+
+        def opt():
+            model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+            return optim.LocalOptimizer(
+                model, None, nn.ClassNLLCriterion()).set_workload(
+                    "ptb_lstm")
+
+        # setter never called: tuned policy applies
+        assert opt()._resolved_activation_memory() == "dots"
+        # explicit None forces the inert policy over the tuned entry
+        assert opt().set_activation_memory(
+            None)._resolved_activation_memory() == "none"
+        # ... and over an env value too
+        monkeypatch.setenv("BIGDL_TPU_ACTIVATION_MEMORY", "full")
+        reset_config()
+        assert opt()._resolved_activation_memory() == "full"
+        assert opt().set_activation_memory(
+            None)._resolved_activation_memory() == "none"
+
+
+# ===========================================================================
+class TestFailureContract:
+    def test_absent_file_is_silent_and_inert(self, caplog):
+        with caplog.at_level(logging.ERROR, logger="bigdl_tpu.tuned"):
+            v, src = tuned.resolve_default("steps_per_dispatch",
+                                           workload="ptb_lstm")
+        assert (v, src) == (1, "default")
+        assert caplog.records == []
+
+    def test_empty_file_is_silent_and_inert(self, monkeypatch, tmp_path,
+                                            caplog):
+        p = tmp_path / "empty.json"
+        p.write_text("")
+        use_file(monkeypatch, p)
+        with caplog.at_level(logging.ERROR, logger="bigdl_tpu.tuned"):
+            assert tuned.resolve_default(
+                "steps_per_dispatch", workload="ptb_lstm") == \
+                (1, "default")
+        assert caplog.records == []
+
+    @pytest.mark.parametrize("text", [
+        "{not json",
+        '{"schema_version": 99, "entries": {}}',
+        '{"entries": {}}',
+    ])
+    def test_damaged_file_rejected_loudly_layer_skipped(
+            self, monkeypatch, tmp_path, caplog, text):
+        p = tmp_path / "bad.json"
+        p.write_text(text)
+        use_file(monkeypatch, p)
+        with caplog.at_level(logging.ERROR, logger="bigdl_tpu.tuned"):
+            v, src = tuned.resolve_default("steps_per_dispatch",
+                                           workload="ptb_lstm")
+        assert (v, src) == (1, "default")
+        assert len(caplog.records) == 1  # ONE loud rejection
+        assert str(p) in caplog.records[0].getMessage()
+
+    def test_one_bad_entry_poisons_whole_file(self, monkeypatch,
+                                              tmp_path, caplog):
+        """Partial trust is no trust: a good entry in a file with one
+        bad knob must NOT be applied."""
+        p = write_doc(tmp_path / "mixed.json", {
+            "ptb_lstm@cpu": make_entry(best={"steps_per_dispatch": 4}),
+            "wide_deep@cpu": make_entry(workload="wide_deep",
+                                        best={"bogus_knob": 1}),
+        })
+        use_file(monkeypatch, p)
+        with caplog.at_level(logging.ERROR, logger="bigdl_tpu.tuned"):
+            v, src = tuned.resolve_default("steps_per_dispatch",
+                                           workload="ptb_lstm")
+        assert (v, src) == (1, "default")
+        assert len(caplog.records) == 1
+
+
+# ===========================================================================
+class TestEngineResetClearsCache:
+    def test_reset_forgets_cached_tuned_file(self, monkeypatch, tmp_path):
+        """The ISSUE-9 regression gate: a prior workload's tuned
+        defaults must not leak across Engine.reset() boundaries."""
+        p = write_doc(tmp_path / "t.json", {"ptb_lstm@cpu": make_entry(
+            best={"steps_per_dispatch": 3})})
+        use_file(monkeypatch, p)
+        assert Engine.steps_per_dispatch(workload="ptb_lstm") == 3
+        write_doc(p, {"ptb_lstm@cpu": make_entry(
+            best={"steps_per_dispatch": 4})})
+        # cached: the rewrite is invisible until a reset
+        assert Engine.steps_per_dispatch(workload="ptb_lstm") == 3
+        Engine.reset()
+        assert Engine.steps_per_dispatch(workload="ptb_lstm") == 4
+
+    def test_reset_cache_alone_reloads(self, monkeypatch, tmp_path):
+        p = write_doc(tmp_path / "t.json", {"ptb_lstm@cpu": make_entry(
+            best={"steps_per_dispatch": 3})})
+        use_file(monkeypatch, p)
+        assert tuned.lookup("ptb_lstm", "steps_per_dispatch") == 3
+        p.unlink()
+        tuned.reset_cache()
+        assert tuned.lookup("ptb_lstm", "steps_per_dispatch") is None
+
+
+# ===========================================================================
+class TestProductionKShim:
+    def test_tuned_entry_wins_with_source(self, monkeypatch, tmp_path):
+        p = write_doc(tmp_path / "t.json", {"ptb_lstm@cpu": make_entry(
+            best={"steps_per_dispatch": 5})})
+        use_file(monkeypatch, p)
+        assert bench.PRODUCTION_K["ptb_lstm"] == 5
+        assert bench.PRODUCTION_K.source("ptb_lstm") == \
+            (5, "tuned_configs.json")
+
+    def test_hand_dict_fallback(self):
+        # fixture points at an absent file: every workload falls back
+        assert bench.PRODUCTION_K["ptb_lstm"] == 8
+        assert bench.PRODUCTION_K.source("wide_deep") == (8, "hand")
+        assert bench.PRODUCTION_K.source("resnet50") == (1, "hand")
+
+    def test_unknown_workload_still_raises(self):
+        with pytest.raises(KeyError):
+            bench.PRODUCTION_K["nope"]
+
+
+# ===========================================================================
+class TestSuccessiveHalving:
+    """Pure search-driver semantics via injected measurements — no jax
+    in the loop."""
+
+    @staticmethod
+    def grid(n):
+        return [{"steps_per_dispatch": 2 ** i} for i in range(n)]
+
+    def test_plan_rungs_spends_budget_back_to_front(self):
+        # ladder [8,4,2,1]; minimal 15; leftover flows to late rungs
+        assert autotune.plan_rungs(8, 24, eta=2, full_windows=4) == \
+            [(8, 1), (4, 1), (2, 4), (1, 4)]
+        assert autotune.plan_rungs(2, 8, eta=2, full_windows=4) == \
+            [(2, 2), (1, 4)]
+
+    def test_plan_refuses_unrankable_budget(self):
+        with pytest.raises(ValueError, match="budget"):
+            autotune.plan_rungs(8, 14)  # minimal is 15
+
+    def test_budget_is_hard_and_rungs_logged(self):
+        calls = []
+
+        def measure(cfg, windows, rung):
+            calls.append(windows)
+            return [100.0 + cfg["steps_per_dispatch"]] * windows
+
+        budget = 24
+        res = autotune.successive_halving(self.grid(8), measure, budget)
+        assert res["windows_total"] == sum(calls) <= budget
+        assert res["budget"] == budget
+        assert [r["trials"] for r in res["rungs"]] == [8, 4, 2, 1]
+        assert [r["survivors"] for r in res["rungs"]] == [4, 2, 1, 1]
+        assert sum(r["windows_used"] for r in res["rungs"]) == \
+            res["windows_total"]
+
+    def test_deterministic_given_same_measurements(self):
+        def measure(cfg, windows, rung):
+            # deterministic but config-dependent; rung-independent
+            base = 100.0 + (cfg["steps_per_dispatch"] * 7919) % 13
+            return [base + 0.01 * w for w in range(windows)]
+
+        a = autotune.successive_halving(self.grid(8), measure, 24)
+        b = autotune.successive_halving(self.grid(8), measure, 24)
+        assert a == b
+
+    def test_best_config_wins(self):
+        def measure(cfg, windows, rung):
+            return [float(cfg["steps_per_dispatch"])] * windows
+
+        res = autotune.successive_halving(self.grid(5), measure, 16)
+        assert res["best_config"] == {"steps_per_dispatch": 16}
+        scores = [e["score"] for e in res["leaderboard"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_exact_tie_breaks_to_smallest_canonical_key(self):
+        def measure(cfg, windows, rung):
+            return [42.0] * windows
+
+        trials = [{"b": 2}, {"a": 1}, {"c": 3}]
+        res = autotune.successive_halving(trials, measure, 12)
+        assert res["best_config"] == {"a": 1}
+        assert autotune.config_key(res["best_config"]) == \
+            min(autotune.config_key(t) for t in trials)
+
+    def test_steady_filter_excludes_outlier_windows(self):
+        steady, excluded = autotune.steady_filter([100, 101, 99, 50])
+        assert excluded == 1 and 50 not in steady
+        # short sample lists pass through untouched
+        assert autotune.steady_filter([100, 50]) == ([100, 50], 0)
+
+    def test_steady_filter_is_the_shared_bench_filter(self):
+        """One implementation (bench.steady_windows) backs both the
+        autotuner and scaling_child, so exclusion accountings stay
+        comparable; a uniformly-unsteady trial scores on the reference
+        with EVERY window counted excluded — never a silent fall-back
+        to the raw set."""
+        import bench
+        samples = [100.0, 101.0, 99.0, 50.0]
+        kept_b, excl_b, _ = bench.steady_windows(samples, min_samples=4)
+        assert autotune.steady_filter(samples) == (kept_b, excl_b)
+        # nothing within ±15% of the reference: ref scored, all excluded
+        unsteady = [100.0, 50.0, 200.0, 10.0]
+        steady, excluded = autotune.steady_filter(unsteady)
+        assert excluded == len(unsteady)
+        assert steady == [bench.steady_windows(unsteady,
+                                               min_samples=4)[2]]
+
+    def test_axis_pruning_is_recorded_not_silent(self):
+        kept, pruned = autotune.prune_axes(
+            autotune._TRAINING_AXES, backend="cpu", n_devices=1)
+        assert {ax.knob for ax in kept} == \
+            {"steps_per_dispatch", "activation_memory"}
+        assert set(pruned) == {"kernel_impl", "grad_wire_dtype",
+                               "grad_bucket_bytes"}
+        assert all(pruned.values())  # every prune carries its reason
+        kept_tpu, pruned_tpu = autotune.prune_axes(
+            autotune._TRAINING_AXES, backend="tpu", n_devices=8)
+        assert pruned_tpu == {}
+
+    def test_grid_build_order_deterministic(self):
+        axes = (autotune.Axis("a", (1, 2)), autotune.Axis("b", ("x",)))
+        assert autotune.build_grid(axes) == [
+            {"a": 1, "b": "x"}, {"a": 2, "b": "x"}]
+
+
+# ===========================================================================
+class RecordingSummary:
+    def __init__(self):
+        self.losses = []
+
+    def add_train_step(self, step, loss, lr, throughput):
+        self.losses.append(loss)
+
+    def add_scalar(self, *a):
+        pass
+
+    def trigger_for(self, name):
+        return None
+
+
+def tiny_run(iters=6, workload=None, k=None):
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(0, 1, (16,)).astype(np.float32),
+                      np.int32(rng.integers(0, 4)))
+               for _ in range(64)]
+    model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(),
+                          nn.Linear(16, 4), nn.LogSoftMax())
+    rec = RecordingSummary()
+    opt = (optim.LocalOptimizer(model,
+                                DataSet.array(samples)
+                                >> SampleToMiniBatch(16),
+                                nn.ClassNLLCriterion())
+           .set_optim_method(optim.SGD(learning_rate=0.1))
+           .set_seed(7)
+           .set_train_summary(rec)
+           .set_end_when(optim.max_iteration(iters)))
+    if workload is not None:
+        opt.set_workload(workload)
+    if k is not None:
+        opt.set_steps_per_dispatch(k)
+    opt.optimize()
+    return np.asarray(rec.losses), opt
+
+
+# ===========================================================================
+class TestInertness:
+    """Enabling the tuned-config layer with an absent or empty file is
+    provably inert (the established bitwise gate pattern)."""
+
+    def test_workload_tag_with_absent_file_bitwise_inert(self):
+        base_losses, base_opt = tiny_run()
+        tag_losses, tag_opt = tiny_run(workload="no_such_workload")
+        np.testing.assert_array_equal(base_losses, tag_losses)
+        assert base_opt._dispatch_count == tag_opt._dispatch_count
+
+    def test_workload_tag_with_empty_file_bitwise_inert(
+            self, monkeypatch, tmp_path):
+        base_losses, base_opt = tiny_run()
+        p = tmp_path / "empty.json"
+        p.write_text("")
+        use_file(monkeypatch, p)
+        tag_losses, tag_opt = tiny_run(workload="ptb_lstm")
+        np.testing.assert_array_equal(base_losses, tag_losses)
+        assert base_opt._dispatch_count == tag_opt._dispatch_count
+
+
+# ===========================================================================
+class TestEndToEnd:
+    """The ISSUE-9 acceptance gate: the CLI completes on CPU, writes a
+    valid file, and a subsequent Optimizer run picks the tuned K up
+    through the resolution chain — proven by dispatch count."""
+
+    def test_autotune_cli_to_optimizer_pickup(self, monkeypatch,
+                                              tmp_path, capsys):
+        out = tmp_path / "tuned.json"
+        rc = autotune.main(["--workload", "ptb_lstm", "--smoke",
+                            "--budget", "6", "--out", str(out)])
+        assert rc == 0
+        printed = json.loads(capsys.readouterr().out.strip())
+        assert printed["windows_total"] <= printed["budget"] == 6
+        assert [r["survivors"] for r in printed["rungs"]][-1] == 1
+        with open(out, "r", encoding="utf-8") as fh:
+            entries = tuned.validate_document(json.load(fh))
+        k = entries["ptb_lstm@cpu"]["best"]["steps_per_dispatch"]
+        assert k in (1, 2)  # the smoke grid
+        # consumption: a fresh process state + the tuned file
+        use_file(monkeypatch, out)
+        assert Engine.steps_per_dispatch(workload="ptb_lstm") == k
+        iters = 6
+        _, opt = tiny_run(iters=iters, workload="ptb_lstm")
+        assert opt._dispatch_count == math.ceil(iters / k)
+        # and an untagged run keeps the dataclass default K=1
+        _, opt0 = tiny_run(iters=iters)
+        assert opt0._dispatch_count == iters
+
+    def test_merge_preserves_other_entries(self, tmp_path):
+        out = write_doc(tmp_path / "t.json", {"wide_deep@cpu": make_entry(
+            workload="wide_deep", best={"steps_per_dispatch": 4})})
+        result = {"best_config": {"steps_per_dispatch": 2}}
+        autotune.write_tuned(str(out), "ptb_lstm", "cpu", result,
+                             {"toolchain": {}})
+        with open(out, "r", encoding="utf-8") as fh:
+            entries = tuned.validate_document(json.load(fh))
+        assert set(entries) == {"wide_deep@cpu", "ptb_lstm@cpu"}
+
+    def test_write_refuses_to_extend_damaged_file(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"schema_version": 99, "entries": {}}')
+        with pytest.raises(tuned.TunedConfigError):
+            autotune.write_tuned(str(bad), "ptb_lstm", "cpu",
+                                 {"best_config": {"steps_per_dispatch": 2}},
+                                 {"toolchain": {}})
+
+    def test_unknown_workload_exits_loudly(self):
+        with pytest.raises(SystemExit, match="unknown workload"):
+            autotune.tune("no_such_workload", budget=4)
+
+    def test_smoke_refuses_default_out_path(self, tmp_path):
+        """A smoke winner (tiny models, tiny grid) must never replace
+        a production-tuned entry in the checked-in file: --smoke
+        without an explicit --out or --dry-run is refused BEFORE any
+        budget is spent."""
+        with pytest.raises(SystemExit, match="smoke"):
+            autotune.tune("ptb_lstm", budget=6, smoke=True)
+        # an explicit out path (the CLI gate test) and dry-run both
+        # stay legal — only the default checked-in path is protected
+        res = autotune.tune("ptb_lstm", budget=6, smoke=True,
+                            dry_run=True,
+                            measure=lambda t, w, r: [1.0] * w)
+        assert res["smoke"] and "out" not in res
+
+
+# ===========================================================================
+class TestMeasureActivationMemory:
+    """The bench._measure remat knob the autotuner trials sweep."""
+
+    def _xy(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        return (jnp.asarray(rng.normal(0, 1, (8, 16))
+                            .astype(np.float32)),
+                jnp.asarray(rng.integers(0, 4, (8,)).astype(np.int32)))
+
+    def _model(self):
+        return nn.Sequential(nn.Linear(16, 16), nn.ReLU(),
+                             nn.Linear(16, 4), nn.LogSoftMax())
+
+    def test_invalid_policy_rejected(self):
+        x, y = self._xy()
+        with pytest.raises(ValueError, match="activation_memory"):
+            bench._measure(self._model(), 8, 1, 1, x=x, y=y,
+                           criterion=nn.ClassNLLCriterion(),
+                           activation_memory="bf16")
+
+    def test_dots_policy_measures(self):
+        x, y = self._xy()
+        samples, ca, _ = bench._measure(
+            self._model(), 8, 1, 2, x=x, y=y,
+            criterion=nn.ClassNLLCriterion(),
+            activation_memory="dots")
+        assert len(samples) == 1 and samples[0] > 0
+
+
+# ===========================================================================
+class TestServingKnobs:
+    """parse_row_buckets spec grammar + the tuned serving path."""
+
+    def test_spec_grammar(self):
+        from bigdl_tpu.serving.service import parse_row_buckets
+        assert parse_row_buckets("", 32) == (1, 2, 4, 8, 16, 32)
+        assert parse_row_buckets("pow2", 32) == (1, 2, 4, 8, 16, 32)
+        assert parse_row_buckets("top", 32) == (32,)
+        assert parse_row_buckets("8,16,32", 32) == (8, 16, 32)
+
+    @pytest.mark.parametrize("spec", ["8,x", "16,8", "8,8,16", "0,8",
+                                      "4,8"])
+    def test_bad_specs_rejected(self, spec):
+        from bigdl_tpu.serving.service import parse_row_buckets
+        with pytest.raises(ValueError):
+            parse_row_buckets(spec, 32)
+
+    def test_explicit_tuple_validated_through_same_grammar(self):
+        from bigdl_tpu.serving.service import parse_row_buckets
+        with pytest.raises(ValueError):
+            parse_row_buckets("16,8", 8)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))

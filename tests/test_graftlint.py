@@ -717,6 +717,21 @@ class TestRealTree:
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 
+    def test_autotuner_lints_clean(self):
+        """Standalone gate for the autotuner (round-11, ISSUE-9):
+        tools/autotune.py is pure host-side search/driver code — every
+        measurement rides bench._measure or the serving engine, so any
+        traced-scope hazard surfacing here means search code leaked
+        into a jit.  utils/tuned.py (the consumption side) rides the
+        bigdl_tpu gate above but is host-side-only by the same
+        contract, so it gets the explicit gate too."""
+        result = lint_paths([os.path.join(REPO, "tools", "autotune.py"),
+                             os.path.join(REPO, "bigdl_tpu", "utils",
+                                          "tuned.py")])
+        assert result.files_scanned == 2
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
     def test_checkpoint_package_lints_clean(self):
         """Same standalone discipline for the checkpoint package: its
         one device fetch (snapshot.capture_to_host) is only legal at
