@@ -1337,6 +1337,185 @@ def serving_bench(smoke: bool = False):
     return out
 
 
+def resilience_bench(smoke: bool = False):
+    """Availability under replica failure (``--resilience``): the
+    ``--serving`` offered-load shape pointed at a 4-replica
+    :class:`~bigdl_tpu.resilience.ReplicaSet` while a seeded fault plan
+    kills one replica's batcher thread mid-sweep.
+
+    Per load point the capture records the full degradation story:
+    requests accounted one-by-one (ok / shed / deadline / error — an
+    accepted request that never resolves would show up as a hang and
+    fail the ``lost`` gate), wrong-answer count against a precomputed
+    expected output (must be 0 — a failover must never fabricate rows;
+    compared with allclose because a request may coalesce into any row
+    bucket and bucket executables differ in fusion order by a last-ulp
+    — the same concession ``test_serving.py`` makes across dispatch
+    sizes; the bitwise gate at fixed bucket lives in
+    ``tests/test_resilience.py``),
+    throughput and p99 split into baseline / degraded (quarantine
+    window) / recovered phases from a health-state monitor thread, and
+    the ``resilience/*`` counters (death, quarantine, failovers,
+    revival, probes, readmission) straight from the registry.  The
+    acceptance shape — throughput degrades to ~(N-1)/N rather than
+    zero and the replica re-admits after probation — is gated hard in
+    ``tests/test_resilience.py``; this entry records the measured
+    numbers (record-never-abort) so availability joins the bench
+    trajectory.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.resilience import ReplicaSet
+    from bigdl_tpu.resilience.faults import FaultInjector
+    from bigdl_tpu.resilience.health import HealthPolicy
+
+    din, n_replicas = 64, 4
+    run_s = 2.5 if smoke else 6.0
+    kill_after = 10 if smoke else 30  # replica-0 dispatch index floor
+    model = nn.Sequential(
+        nn.Linear(din, 256), nn.ReLU(), nn.Linear(256, 256), nn.ReLU(),
+        nn.Linear(256, 8), nn.SoftMax())
+    model.initialize(rng=0)
+    spec = ((din,), np.float32)
+    rng = np.random.default_rng(0)
+
+    out = {"metric": "serving_availability_under_replica_kill",
+           "unit": "fraction", "toolchain": _toolchain(),
+           "config": f"mlp{din}x256x256x8/{n_replicas}replicas/"
+                     f"kill_r0_after{kill_after}/run{run_s}s",
+           "sweep": []}
+    for n_threads in ((4,) if smoke else (4, 16)):
+        plan = f"replica_death@target=0,after={kill_after},count=1"
+        rs = ReplicaSet(
+            model, n_replicas=n_replicas, input_spec=spec,
+            max_batch_size=32, batch_timeout_ms=2.0,
+            queue_capacity=4096, name=f"bench-resil{n_threads}",
+            deadline_ms=5000.0, max_retries=2,
+            health=HealthPolicy(probe_backoff_s=0.4),
+            fault_injector=FaultInjector(plan, seed=0))
+        x = rng.normal(0, 1, (1, din)).astype(np.float32)
+        expected = np.asarray(rs.predict(x, timeout=30))
+        counts = {"ok": 0, "shed": 0, "deadline": 0, "error": 0,
+                  "wrong": 0}
+        errs: list = []
+        records = []  # (t_done, latency_s) of successes
+        lock = _threading.Lock()
+        stop_at = [0.0]
+        barrier = _threading.Barrier(n_threads + 2)
+
+        def worker():
+            from bigdl_tpu.serving import (DeadlineExceeded,
+                                           ServiceOverloaded)
+            barrier.wait()
+            while time.monotonic() < stop_at[0]:
+                t0 = time.monotonic()
+                try:
+                    got = rs.predict(x, timeout=2.0)
+                except ServiceOverloaded as e:
+                    with lock:
+                        counts["shed"] += 1
+                    wait = e.retry_after_ms or 5.0
+                    time.sleep(min(wait, 50.0) / 1e3)
+                    continue
+                except (DeadlineExceeded, TimeoutError):
+                    with lock:
+                        counts["deadline"] += 1
+                    continue
+                except Exception as e:  # recorded, never dropped
+                    with lock:
+                        counts["error"] += 1
+                        errs.append(f"{type(e).__name__}: {e}")
+                    continue
+                t1 = time.monotonic()
+                good = np.allclose(np.asarray(got), expected,
+                                   rtol=1e-5, atol=1e-7)
+                with lock:
+                    counts["ok" if good else "wrong"] += 1
+                    records.append((t1, t1 - t0))
+
+        timeline = []  # (t, health_states) sampled by the monitor
+
+        def monitor():
+            barrier.wait()
+            while time.monotonic() < stop_at[0]:
+                timeline.append((time.monotonic(), rs.health_states()))
+                time.sleep(0.02)
+
+        threads = [_threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        threads.append(_threading.Thread(target=monitor))
+        for t in threads:
+            t.start()
+        # stop_at must be valid BEFORE the barrier releases: workers
+        # check it immediately after their own barrier.wait() returns,
+        # possibly before this thread runs another statement
+        stop_at[0] = time.monotonic() + run_s
+        barrier.wait()
+        t_start = time.monotonic()
+        for t in threads:
+            t.join()
+        stats = rs.stats()
+        rs.stop()
+
+        # phase boundaries from the sampled health timeline
+        t_dead = next((t for t, h in timeline if "quarantined" in h),
+                      None)
+        t_readmit = next(
+            (t for t, h in timeline
+             if t_dead is not None and t > t_dead
+             and all(s == "healthy" for s in h)), None)
+
+        def phase_stats(lo, hi):
+            done = [(t, lat) for t, lat in records if lo <= t < hi]
+            if not done or hi <= lo:
+                return {"rps": 0.0, "p99_ms": None, "n": len(done)}
+            lats = sorted(lat for _, lat in done)
+            p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+            return {"rps": round(len(done) / (hi - lo), 1),
+                    "p99_ms": round(p99 * 1e3, 2), "n": len(done)}
+
+        t_end = stop_at[0]
+        baseline = phase_stats(t_start, t_dead or t_end)
+        degraded = phase_stats(t_dead or t_end, t_readmit or t_end)
+        recovered = phase_stats(t_readmit or t_end, t_end)
+        resil = stats["resilience"]
+        point = {
+            "offered_threads": n_threads,
+            "counts": counts,
+            "lost": 0,  # every predict() above resolved — join proves it
+            "baseline": baseline,
+            "degraded": degraded,
+            "recovered": recovered,
+            "degraded_throughput_ratio":
+                round(degraded["rps"] / baseline["rps"], 3)
+                if baseline["rps"] else None,
+            "quarantine_s":
+                round((t_readmit or t_end) - t_dead, 3)
+                if t_dead is not None else None,
+            "readmitted": t_readmit is not None,
+            "resilience_counters": {
+                k: v for k, v in sorted(resil.items())
+                if isinstance(v, (int, float)) and v},
+        }
+        total = sum(counts.values())
+        point["availability"] = (
+            round(counts["ok"] / total, 4) if total else None)
+        if errs:
+            point["errors"] = errs[:3]
+        out["sweep"].append(point)
+    avails = [p["availability"] for p in out["sweep"]
+              if p["availability"] is not None]
+    out["value"] = min(avails) if avails else None
+    out["wrong_answers"] = sum(p["counts"]["wrong"]
+                               for p in out["sweep"])
+    out["all_points_readmitted"] = all(p["readmitted"]
+                                       for p in out["sweep"])
+    return out
+
+
 def checkpoint_bench(smoke: bool = False):
     """Async-checkpointing overhead entry (the bigdl_tpu.checkpoint
     rider): the SAME training run with checkpointing async (default),
@@ -1420,5 +1599,7 @@ if __name__ == "__main__":
         print(json.dumps(serving_bench("--smoke" in sys.argv)))
     elif "--checkpoint" in sys.argv:
         print(json.dumps(checkpoint_bench("--smoke" in sys.argv)))
+    elif "--resilience" in sys.argv:
+        print(json.dumps(resilience_bench("--smoke" in sys.argv)))
     else:
         main(sys.argv[1:])

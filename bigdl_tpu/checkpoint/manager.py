@@ -148,7 +148,9 @@ class CheckpointManager:
         if sync or self._writer is None:
             job()
         else:
-            self._writer.submit(job)  # blocks only when 2 writes deep
+            # context travels with the job: a deferred write error
+            # names exactly which snapshot was lost
+            self._writer.submit(job, context=f"step {step} → {path}")
         self.last_saved_step = int(step)
         stall = time.perf_counter() - t0
         self._driver_stall_s += stall

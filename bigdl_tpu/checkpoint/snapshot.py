@@ -386,9 +386,13 @@ class AsyncSnapshotWriter:
     """
 
     def __init__(self, capacity: int = 2):
-        self._q: "queue.Queue[Optional[Callable[[], Any]]]" = \
+        # queue items: (job, context) — context is the human label
+        # ("step N → path") a deferred error is reported under, because
+        # by the time the error surfaces the failing submit is long gone
+        self._q: "queue.Queue[Optional[tuple]]" = \
             queue.Queue(maxsize=max(1, int(capacity)))
         self._error: Optional[BaseException] = None
+        self._error_context: Optional[str] = None
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -401,31 +405,40 @@ class AsyncSnapshotWriter:
 
     def _run(self) -> None:
         while True:
-            job = self._q.get()
+            item = self._q.get()
             try:
-                if job is None:
+                if item is None:
                     return
+                job, context = item
                 job()
             except BaseException as e:  # surfaced on next submit/drain
                 with self._lock:
                     self._error = e
+                    self._error_context = context
             finally:
                 self._q.task_done()
 
     def _raise_pending(self) -> None:
         with self._lock:
             err, self._error = self._error, None
+            ctx, self._error_context = self._error_context, None
         if err is not None:
+            what = f" ({ctx})" if ctx else ""
             raise RuntimeError(
-                "async checkpoint write failed (training state was NOT "
-                "durably saved)") from err
+                f"async checkpoint write failed{what} — training state "
+                f"was NOT durably saved") from err
 
-    def submit(self, job: Callable[[], Any]) -> None:
+    def submit(self, job: Callable[[], Any],
+               context: Optional[str] = None) -> None:
+        """Enqueue one commit job.  ``context`` names what the job was
+        writing ("step N → path") so a deferred failure can report
+        exactly which snapshot was lost — rollback policy logs what it
+        fell back from."""
         if self._closed:
             raise RuntimeError("AsyncSnapshotWriter is closed")
         self._raise_pending()
         self._ensure_thread()
-        self._q.put(job)  # blocks when the bounded queue is full
+        self._q.put((job, context))  # blocks when the queue is full
 
     def drain(self) -> None:
         """Block until every submitted job committed; re-raise any

@@ -210,10 +210,20 @@ class MTSampleToMiniBatch(Transformer):
             with sample_key((pass_ix << 40) | ix):
                 return self.transform(sample)
 
+        # the producer's terminal error, recorded OUT of band: queue
+        # delivery can fail (e.g. the pool itself refuses to start under
+        # thread exhaustion), and the consumer must still be able to
+        # surface the ORIGINAL error instead of blocking on get() forever
+        failure: list = [None]
+
         def producer():
-            pool = ThreadPoolExecutor(max_workers=self.workers)
+            pool = None
             stream_ix = 0
             try:
+                # inside the try: a ThreadPoolExecutor that cannot start
+                # (resource exhaustion) must take the error path below,
+                # not kill this thread with the consumer still blocked
+                pool = ThreadPoolExecutor(max_workers=self.workers)
                 buf = []
                 # map the per-sample transform with bounded lookahead:
                 # chunks of one batch keep memory flat
@@ -242,11 +252,13 @@ class MTSampleToMiniBatch(Transformer):
                 if buf and not self.drop_remainder:
                     put_or_stop(_stack(buf))
             except BaseException as e:  # surface worker errors to consumer
+                failure[0] = e  # out-of-band first: survives a failed put
                 put_or_stop(e)
             finally:
                 # cancel queued per-sample work so idle workers exit now
                 # instead of grinding through a chunk nobody will read
-                pool.shutdown(wait=False, cancel_futures=True)
+                if pool is not None:
+                    pool.shutdown(wait=False, cancel_futures=True)
                 # propagate shutdown upstream: in a chained pipeline the
                 # source is itself a generator (possibly another MT
                 # assembler) whose own cleanup must run NOW, on the one
@@ -270,7 +282,22 @@ class MTSampleToMiniBatch(Transformer):
         t.start()
         try:
             while True:
-                item = out_q.get()
+                try:
+                    # bounded get + liveness check: a producer thread
+                    # that died without delivering _END (or its error)
+                    # must surface on the next pull — the downstream
+                    # DeviceBlockStager.take() sits directly on this
+                    # generator, and an unbounded get() here would wedge
+                    # the training driver forever
+                    item = out_q.get(timeout=0.2)
+                except queue.Empty:
+                    if t.is_alive() or not out_q.empty():
+                        continue
+                    if failure[0] is not None:
+                        raise failure[0]
+                    raise RuntimeError(
+                        "batch-assembly producer thread died without "
+                        "delivering an end-of-stream marker or error")
                 if item is _END:
                     return
                 if isinstance(item, BaseException):

@@ -208,6 +208,11 @@ class Engine:
             "queue_capacity": cls._resolve("serving_queue_capacity",
                                            workload),
             "row_buckets": cls._resolve("serving_row_buckets", workload),
+            # resilience: the per-request deadline a ReplicaSet stamps
+            # on submissions (0 = none) — same resolution chain as the
+            # other serving knobs so the autotuner can tune it per
+            # workload
+            "deadline_ms": cls._resolve("serving_deadline_ms", workload),
         }
 
     # -- XLA collective scheduling ----------------------------------------

@@ -56,8 +56,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.checkpoint import build_schema
 from bigdl_tpu.engine import Engine
-from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.optimizer import (Optimizer, select_step,
+                                       step_finite)
 from bigdl_tpu.parallel import grad_sync
+from bigdl_tpu.resilience.numeric import NonFiniteStepError
 
 logger = logging.getLogger("bigdl_tpu.optim")
 
@@ -233,14 +235,32 @@ class DistriOptimizer(Optimizer):
         clip_spec = self.grad_clip_spec if self.grad_clip is not None \
             else None
 
+        guard = self._resolved_numeric_guard()
+
         def one_step(params, mstate, ostate, x, y, lr, step, rng):
             (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
-            params, ostate = grad_sync.sync_and_update(
+            if guard != "off":
+                # mesh-global finite verdict: every chip must agree so
+                # the jnp.where gate below selects identically on every
+                # owned ZeRO-1 slice (pmin of the local flags — one
+                # poisoned chip vetoes the whole step)
+                finite = jax.lax.pmin(
+                    step_finite(loss, grads).astype(jnp.int32),
+                    axis).astype(bool)
+            new_params, new_ostate = grad_sync.sync_and_update(
                 plan, grads, ostate, optim, lr, step,
                 wire_dtype=wire, axis_name=axis, clip_spec=clip_spec)
-            new_mstate = grad_sync.sync_model_state(new_mstate, axis)
-            return params, new_mstate, ostate, \
-                jax.lax.pmean(loss, axis)
+            synced_mstate = grad_sync.sync_model_state(new_mstate, axis)
+            loss_out = jax.lax.pmean(loss, axis)
+            if guard == "off":
+                return new_params, synced_mstate, new_ostate, loss_out
+            if guard == "skip":
+                return (select_step(finite, new_params, params),
+                        select_step(finite, synced_mstate, mstate),
+                        select_step(finite, new_ostate, ostate),
+                        (loss_out, finite))
+            return new_params, synced_mstate, new_ostate, \
+                (loss_out, finite)
 
         body = self._block_body(one_step, k)
 
@@ -412,6 +432,18 @@ class DistriOptimizer(Optimizer):
         while True:
             try:
                 return self._optimize_impl()
+            except NonFiniteStepError as e:
+                # numeric_guard: "abort" must surface at the exact
+                # iteration — the one failure class the reference-style
+                # retry loop below must NOT swallow; "rollback" runs
+                # the shared restore-latest-valid recovery.  The budget
+                # is read LIVE from config (like LocalOptimizer and the
+                # dispatch-retry loop), not from the Engine-init
+                # snapshot the legacy loop below still uses.
+                attempts += 1
+                from bigdl_tpu.utils.config import get_config
+                self._rollback_nonfinite(
+                    e, attempts, get_config().failure_retry_times)
             except Exception:
                 # reference retry-from-checkpoint loop
                 # (DistriOptimizer.scala:981-1061), now on the manager:

@@ -67,6 +67,9 @@ from bigdl_tpu.optim.trigger import Trigger, max_epoch, probe_fire_step
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.checkpoint import (CheckpointManager, PreemptionHandler,
                                   build_schema, validate_schema)
+from bigdl_tpu.resilience.faults import FaultInjector, InjectedFault
+from bigdl_tpu.resilience.numeric import (NonFiniteStepError,
+                                          validate_policy)
 from bigdl_tpu.telemetry import DriverTelemetry, NULL_SPAN, jit_cache_size
 from bigdl_tpu.utils.metrics import Metrics
 
@@ -97,6 +100,27 @@ def clip_by_global_norm(grads, max_norm: float):
     norm = global_norm(grads)
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
     return tmap(lambda g: g * scale, grads)
+
+
+def step_finite(loss, grads):
+    """Scalar bool: this step's loss AND every (inexact) gradient leaf
+    are finite.  Computed INSIDE the jit'd step so the flag rides the
+    one-block-behind loss fetch — the numeric guard never adds a host
+    sync (graftlint catalog: "the numeric guard rides the replay
+    boundary")."""
+    finite = jnp.isfinite(loss)
+    for g in jax.tree_util.tree_leaves(grads):
+        if jnp.issubdtype(g.dtype, jnp.inexact):
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return finite
+
+
+def select_step(finite, new, old):
+    """``jnp.where``-select a whole pytree: the updated binding where
+    the step was finite, the pre-step binding otherwise (the dynamic
+    loss-scaling skip idiom — a skipped step leaves params, model state
+    AND optimizer state exactly as if the step never ran)."""
+    return tmap(lambda a, b: jnp.where(finite, a, b), new, old)
 
 
 class _Staged:
@@ -189,6 +213,16 @@ class Optimizer:
         # never called — resolved through the default chain (env/tuned
         # entry may apply; _resolved_activation_memory)
         self.activation_memory: Optional[str] = None
+        # numeric-failure policy (set_numeric_guard): "off" | "skip" |
+        # "rollback" | "abort" — see bigdl_tpu/resilience/numeric.py.
+        # None = setter never called; Config.numeric_guard /
+        # BIGDL_TPU_NUMERIC_GUARD applies.
+        self.numeric_guard: Optional[str] = None
+        # fault injection (bigdl_tpu/resilience/faults): None unless a
+        # Config.fault_plan is live — EVERY driver fault site below
+        # guards on that, so the disabled path is byte-identical
+        self._fault_injector: Optional[FaultInjector] = None
+        self._guard_policy = "off"  # resolved per run by _train_driver
         self._dispatch_count = 0  # jit dispatches issued (observability)
         self._stager: Optional[DeviceBlockStager] = None
         self._epoch_size = 0
@@ -358,6 +392,37 @@ class Optimizer:
         self.activation_memory = "none" if policy is None else policy
         return self
 
+    def set_numeric_guard(self, policy: Optional[str]) -> "Optimizer":
+        """Non-finite loss/gradient policy for this run (overrides
+        ``Config.numeric_guard`` / ``BIGDL_TPU_NUMERIC_GUARD``):
+
+        - ``None`` / ``"off"`` — inert: the step function and the
+          replay fetch are built exactly as before (bitwise loss
+          sequence, equal dispatch count; gated in
+          tests/test_resilience.py).
+        - ``"skip"`` — the jit'd step gates its own update: on a
+          non-finite loss or gradient the params / model-state /
+          optimizer-state updates are ``jnp.where``-selected away ON
+          DEVICE (the dynamic-loss-scaling skip idiom), the step is
+          counted in ``resilience/steps_skipped``, training continues.
+        - ``"rollback"`` — the replay raises
+          :class:`~bigdl_tpu.resilience.NonFiniteStepError`; the
+          optimizer restores the latest VALID snapshot
+          (``CheckpointManager.latest_valid``) and re-runs, bounded by
+          ``Config.failure_retry_times`` — automatic loss-spike
+          recovery (requires ``set_checkpoint``; refused loudly at
+          ``optimize()`` otherwise).
+        - ``"abort"`` — the run fails loudly at the exact iteration.
+
+        The per-step finite flags ride the SAME one-block-behind fetch
+        as the loss vector — no policy adds a host sync."""
+        # explicit None IS the inert policy, not "unset" (the
+        # set_activation_memory contract): it must override an
+        # env-provided policy the same way "off" does
+        self.numeric_guard = "off" if policy is None \
+            else validate_policy(policy)
+        return self
+
     def set_steps_per_dispatch(self, k: int) -> "Optimizer":
         """Fuse ``k`` consecutive train steps into one jit dispatch
         (``lax.scan`` over stacked microbatches).  Loss trajectory and
@@ -441,6 +506,16 @@ class Optimizer:
                 f"activation_memory {policy!r} (from {src}) must be "
                 f"one of {self._ACTIVATION_POLICIES}")
         return policy
+
+    def _resolved_numeric_guard(self) -> str:
+        """Per-run ``set_numeric_guard`` wins; otherwise
+        ``Config.numeric_guard`` (a garbage env value fails loudly
+        here, same as the setter would)."""
+        if self.numeric_guard is not None:
+            return self.numeric_guard
+        from bigdl_tpu.utils.config import get_config
+        return validate_policy(get_config().numeric_guard,
+                               source="Config.numeric_guard")
 
     def _loss_and_grad_fn(self):
         model, criterion = self.model, self.criterion
@@ -656,10 +731,14 @@ class Optimizer:
             def body(params, mstate, ostate, xs, ys, lrs, steps, rngs):
                 x = tmap(lambda a: a[0], xs)
                 y = None if ys is None else tmap(lambda a: a[0], ys)
-                params, mstate, ostate, loss = one_step(
+                params, mstate, ostate, out = one_step(
                     params, mstate, ostate, x, y, lrs[0], steps[0],
                     rngs[0])
-                return params, mstate, ostate, loss[None]
+                # `out` is the loss scalar — or (loss, finite) under a
+                # live numeric guard; either way every leaf grows the
+                # length-1 step axis the replay convention expects
+                return params, mstate, ostate, tmap(lambda l: l[None],
+                                                    out)
             return body
 
         def body(params, mstate, ostate, xs, ys, lrs, steps, rngs):
@@ -692,14 +771,34 @@ class Optimizer:
         grad_clip = self.grad_clip
         optim = self.optim_method
         constrain = self._constrain_step_outputs
+        guard = self._resolved_numeric_guard()
 
         def one_step(params, mstate, ostate, x, y, lr, step, rng):
             (loss, new_mstate), grads = grad_fn(params, mstate, x, y, rng)
             if grad_clip is not None:
                 grads = grad_clip(grads)
-            params, ostate = optim.update(grads, params, ostate, lr, step)
-            params, ostate = constrain(params, ostate)
-            return params, new_mstate, ostate, loss
+            if guard == "off":
+                # byte-identical to the pre-guard step — the provably
+                # inert state (gated in tests/test_resilience.py)
+                params, ostate = optim.update(grads, params, ostate, lr,
+                                              step)
+                params, ostate = constrain(params, ostate)
+                return params, new_mstate, ostate, loss
+            finite = step_finite(loss, grads)
+            new_params, new_ostate = optim.update(grads, params, ostate,
+                                                  lr, step)
+            new_params, new_ostate = constrain(new_params, new_ostate)
+            if guard == "skip":
+                # gate the whole update on device: a non-finite step
+                # leaves params/mstate/ostate exactly as before it
+                return (select_step(finite, new_params, params),
+                        select_step(finite, new_mstate, mstate),
+                        select_step(finite, new_ostate, ostate),
+                        (loss, finite))
+            # rollback/abort: update as usual, just report the flag —
+            # the replay raises at the exact iteration and recovery
+            # discards these params anyway
+            return new_params, new_mstate, new_ostate, (loss, finite)
 
         return jax.jit(self._block_body(one_step, k),
                        donate_argnums=(0, 1, 2))
@@ -735,6 +834,33 @@ class Optimizer:
             # optimizer — _tel_span/_replay_block read self._telemetry,
             # so a stale one would keep recording through an "off" run
             self._telemetry = None
+        # resilience: the numeric-guard policy this run's block fns and
+        # replay share, and the fault injector (None — the provably
+        # inert state — unless Config.fault_plan is live; every site
+        # below guards on that)
+        guard = self._guard_policy = self._resolved_numeric_guard()
+        if guard == "rollback" and not self.checkpoint_path:
+            raise ValueError(
+                "numeric_guard='rollback' needs set_checkpoint(path, "
+                "trigger) — there is no snapshot to roll back to")
+        from bigdl_tpu.utils.config import get_config
+        cfg_plan = get_config().fault_plan or ""
+        if self._fault_injector is not None \
+                and self._fault_injector.plan != cfg_plan:
+            # the configured plan CHANGED since this injector was
+            # built (a reused optimizer across configure() calls) —
+            # honor the knob, including clearing it back to inert
+            self._fault_injector = None
+        if self._fault_injector is None and cfg_plan:
+            # built once per (optimizer, plan), not per attempt: a
+            # fault plan describes one timeline of the outside world,
+            # so clause firing budgets (count=) must survive the
+            # rollback/retry loops re-entering this driver
+            self._fault_injector = FaultInjector.from_config(
+                registry=self.metrics.registry)
+            logger.warning("fault injection live: %s",
+                           self._fault_injector.describe())
+        faults = self._fault_injector
         # checkpointing: manager built up front so the stall-fraction
         # denominator starts at the run, and preemption (SIGTERM/SIGINT
         # → finish block + final snapshot + clean return) has somewhere
@@ -787,6 +913,11 @@ class Optimizer:
             with self.metrics.time("data"):
                 xs, ys, sizes = stager.take(k_plan, budget)
             k = len(sizes)
+            if faults is not None:
+                # batch-poison fault site (corrupt_batch/nonfinite_grads
+                # clauses, keyed by global iteration number) — only ever
+                # reached with a live plan
+                xs = faults.corrupt_staged(xs, p_neval, k)
             bsz_hint = sizes[0]
             # per-step host scalars, one current_lr call per iteration in
             # order (schedules and the retry tests rely on that cadence)
@@ -856,9 +987,25 @@ class Optimizer:
                 t0 = time.perf_counter()
                 with self._tel_span("dispatch", "dispatch", k=k,
                                     compile=new_fn):
-                    params, mstate, ostate, losses = fn(
-                        params, mstate, ostate, staged.xs, staged.ys,
-                        staged.lrs_dev, staged.steps_dev, staged.rngs_dev)
+                    if faults is None:
+                        params, mstate, ostate, losses = fn(
+                            params, mstate, ostate, staged.xs, staged.ys,
+                            staged.lrs_dev, staged.steps_dev,
+                            staged.rngs_dev)
+                    else:
+                        # dispatch fault site + bounded retry-with-
+                        # backoff: the injector fires BEFORE the jit
+                        # call, so a retried attempt still owns every
+                        # donated buffer (a post-donation error is not
+                        # transiently retryable — the inputs are gone)
+                        params, mstate, ostate, losses = \
+                            self._dispatch_with_retry(
+                                lambda: fn(params, mstate, ostate,
+                                           staged.xs, staged.ys,
+                                           staged.lrs_dev,
+                                           staged.steps_dev,
+                                           staged.rngs_dev),
+                                self._dispatch_count)
                 self._dispatch_count += 1
                 if tel is not None:
                     # recompile watchdog: the first compile of each block
@@ -915,6 +1062,83 @@ class Optimizer:
                         "teardown of an already-failing run")
         return params, mstate, ostate
 
+    def _on_nonfinite_step(self, j: int, losses) -> None:
+        """One replayed iteration carried a non-finite loss/grad flag.
+        ``skip``: the update was already gated away on device — count
+        it and move on.  ``rollback``/``abort``: raise at the exact
+        iteration (rollback is caught by the optimize() recovery loop,
+        abort surfaces to the caller).  Reports the 0-based global step
+        index — the same index fault plans (``corrupt_batch@at=N``) and
+        lr schedules see, one less than the just-incremented
+        ``state["neval"]`` completion count."""
+        policy = self._guard_policy
+        step = self.state["neval"] - 1
+        reg = self.metrics.registry
+        reg.counter("resilience/nonfinite_steps").inc()
+        if policy == "skip":
+            reg.counter("resilience/steps_skipped").inc()
+            if self._telemetry is not None:
+                self._telemetry.tracer.instant(
+                    "nonfinite_step_skipped", cat="resilience",
+                    step=step)
+            logger.warning(
+                "non-finite step at iteration %d (loss=%s) — update "
+                "skipped on device", step, float(losses[j]))
+            return
+        raise NonFiniteStepError(step, float(losses[j]), policy)
+
+    def _rollback_nonfinite(self, e: NonFiniteStepError,
+                            attempts: int, retry_budget: int) -> None:
+        """``numeric_guard="rollback"`` recovery shared by both
+        drivers: restore the latest VALID snapshot, or re-raise ``e``
+        (policy isn't rollback, budget spent, no checkpointing, or
+        nothing valid on disk).  The ``resilience/rollbacks`` counter
+        is bumped only once a restorable snapshot is in hand — it
+        audits restores that actually happened."""
+        if e.policy != "rollback":
+            raise e
+        if attempts > retry_budget or not self.checkpoint_path:
+            raise e
+        mgr = self._checkpoint_manager()
+        mgr.wait()  # writer idle: see every committed snapshot
+        ckpt = mgr.latest_valid()
+        if ckpt is None:
+            raise e
+        self.metrics.registry.counter("resilience/rollbacks").inc()
+        logger.warning(
+            "non-finite step at iteration %d; rollback %d/%d from %s",
+            e.step, attempts, retry_budget, ckpt)
+        mgr.restore_into(self, ckpt, verified=True)
+
+    def _dispatch_with_retry(self, fire, index: int):
+        """Bounded retry-with-backoff around one block dispatch, only
+        reached when fault injection is live.  The injector's driver
+        site raises BEFORE ``fire()`` runs, so a retried attempt still
+        owns the donated buffers; ``InjectedFault`` is transient by
+        construction, so retrying it is exactly the degradation path a
+        real transient dispatch failure (preempted ICI, momentary
+        RESOURCE_EXHAUSTED) would take."""
+        from bigdl_tpu.utils.config import get_config
+        retries = get_config().failure_retry_times
+        faults = self._fault_injector
+        attempt = 0
+        while True:
+            try:
+                faults.driver_dispatch(index)
+                return fire()
+            except InjectedFault:
+                attempt += 1
+                self.metrics.registry.counter(
+                    "resilience/dispatch_retries").inc()
+                if attempt > retries:
+                    raise
+                backoff = min(0.01 * (2.0 ** (attempt - 1)), 1.0)
+                logger.warning(
+                    "transient dispatch failure at dispatch %d; retry "
+                    "%d/%d in %.0f ms", index, attempt, retries,
+                    backoff * 1e3)
+                time.sleep(backoff)
+
     def _replay_block(self, block: _InFlight, params, mstate, ostate):
         """Fetch a dispatched block's per-step losses (the driver's only
         device→host sync — one block behind the dispatch on the steady
@@ -930,9 +1154,16 @@ class Optimizer:
                                steps=len(block.sizes)):
             # the driver's one and only device→host sync: the
             # one-block-behind loss fetch (GL107-safe — the span wraps
-            # the fetch the driver already performs, never adds one)
-            losses = np.asarray(jax.device_get(block.losses))
+            # the fetch the driver already performs, never adds one).
+            # Under a live numeric guard the block returns
+            # (losses, finite_flags) — the flags ride the SAME fetch,
+            # so no policy adds a sync
+            fetched = jax.device_get(block.losses)
         t_wait1 = time.perf_counter()
+        if isinstance(fetched, tuple):
+            losses, finite = np.asarray(fetched[0]), np.asarray(fetched[1])
+        else:
+            losses, finite = np.asarray(fetched), None
         if tel is not None:
             # the block's in-flight window (dispatch → losses landed) on
             # a virtual "device" track, so Perfetto shows device blocks
@@ -952,6 +1183,8 @@ class Optimizer:
                 state["records_processed_this_epoch"] += n
                 state["loss"] = float(losses[j])
                 state["throughput"] = n / per_step
+                if finite is not None and not finite[j]:
+                    self._on_nonfinite_step(j, losses)
                 lr = block.lrs[j]
                 self._log_train_iteration(lr)
                 if self.train_summary is not None:
@@ -1048,6 +1281,23 @@ class LocalOptimizer(Optimizer):
     """
 
     def optimize(self) -> Module:
+        attempts = 0
+        while True:
+            try:
+                return self._optimize_impl()
+            except NonFiniteStepError as e:
+                # numeric_guard="rollback": automatic loss-spike
+                # recovery — restore the latest VALID snapshot (torn/
+                # corrupt ones are skipped, never loaded) and re-run,
+                # bounded by failure_retry_times.  "abort" (and an
+                # exhausted budget) surfaces to the caller at the exact
+                # failing iteration.
+                attempts += 1
+                from bigdl_tpu.utils.config import get_config
+                self._rollback_nonfinite(
+                    e, attempts, get_config().failure_retry_times)
+
+    def _optimize_impl(self) -> Module:
         rng = jax.random.PRNGKey(self.seed)
         rng, init_rng = jax.random.split(rng)
         if self.model._params is not None:

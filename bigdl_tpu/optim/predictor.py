@@ -25,6 +25,7 @@ copied rows would mask cross-row leakage bit-exactly.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterable, Optional, Sequence
 
 import jax
@@ -252,8 +253,22 @@ class PredictionService:
         coalesced bucket dispatches).  Coerced via ``np.asarray`` like
         the historical implementation, so list-of-lists inputs keep
         working (the engine itself would read a nested list as a
-        pytree of scalars)."""
-        out = self.service.predict(np.asarray(features))
+        pytree of scalars).
+
+        Historical callers predate backpressure, so a transient
+        :class:`~bigdl_tpu.serving.ServiceOverloaded` gets ONE bounded
+        internal retry after the exception's own ``retry_after_ms``
+        drain estimate — sustained overload still surfaces (the second
+        rejection propagates; shedding exists to be felt upstream)."""
+        from bigdl_tpu.serving import ServiceOverloaded
+        x = np.asarray(features)
+        try:
+            out = self.service.predict(x)
+        except ServiceOverloaded as e:
+            wait_ms = e.retry_after_ms if e.retry_after_ms is not None \
+                else 10.0
+            time.sleep(min(wait_ms, 1000.0) / 1e3)
+            out = self.service.predict(x)  # second rejection propagates
         with self._stats_lock:
             self.request_count += 1
         return out

@@ -1,0 +1,44 @@
+"""bigdl_tpu.resilience — designed-in failure handling.
+
+Three layers, one discipline (failures are expected events, and every
+degradation path is gated by a deterministic test):
+
+- :mod:`~bigdl_tpu.resilience.faults` — seeded, scoped, provably-inert
+  fault injection (``Config.fault_plan`` / ``BIGDL_TPU_FAULT_PLAN``);
+- :mod:`~bigdl_tpu.resilience.replica_set` — self-healing
+  replica-per-device serving: least-queue-depth routing, per-replica
+  health quarantine/probation, deadlines, bounded failover retry, load
+  shedding with retry-after;
+- :mod:`~bigdl_tpu.resilience.numeric` — the training driver's
+  non-finite loss/grad guard policies (``skip`` | ``rollback`` |
+  ``abort``) riding the one-block-behind fetch.
+
+``ReplicaSet`` is imported lazily (PEP 562) so training-only processes
+never pay the serving import.
+"""
+
+from bigdl_tpu.resilience.faults import (FaultClause, FaultInjector,
+                                         InjectedFault,
+                                         ReplicaDeathFault,
+                                         parse_fault_plan)
+from bigdl_tpu.resilience.health import (CircuitBreaker, HealthPolicy,
+                                         ReplicaHealth)
+from bigdl_tpu.resilience.numeric import (NUMERIC_POLICIES,
+                                          NonFiniteStepError)
+
+__all__ = [
+    "FaultClause", "FaultInjector", "InjectedFault", "ReplicaDeathFault",
+    "parse_fault_plan", "CircuitBreaker", "HealthPolicy", "ReplicaHealth",
+    "NUMERIC_POLICIES", "NonFiniteStepError", "ReplicaSet",
+    "ReplicaDeadError",
+]
+
+_LAZY = {"ReplicaSet", "ReplicaDeadError"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from bigdl_tpu.resilience import replica_set
+        return getattr(replica_set, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,50 @@
+"""Numeric-failure policy shared by the training drivers.
+
+The driver's non-finite guard (``Optimizer.set_numeric_guard`` /
+``Config.numeric_guard``) detects a NaN/Inf loss or gradient **at the
+replay boundary** — the per-step finite flags ride the same
+one-block-behind fetch as the loss vector, so the guard adds no host
+sync (the GL107 discipline; graftlint catalog note "the numeric guard
+rides the replay boundary").  Policies:
+
+- ``"off"`` (default) — provably inert: the step function and the
+  replay fetch are built exactly as before (bitwise loss sequences,
+  equal dispatch counts; gated in ``tests/test_resilience.py``);
+- ``"skip"`` — the jit'd step gates its own update: on a non-finite
+  loss/grad the params/model-state/optimizer-state updates are
+  ``jnp.where``-selected away on device (the dynamic-loss-scaling skip
+  idiom), the step is counted in ``resilience/steps_skipped`` and
+  training continues;
+- ``"rollback"`` — the replay raises :class:`NonFiniteStepError`; the
+  optimizer restores the latest VALID snapshot
+  (``CheckpointManager.latest_valid`` — PR 7) and re-runs, bounded by
+  ``Config.failure_retry_times`` (automatic loss-spike recovery);
+- ``"abort"`` — the replay raises and nothing catches it: the run fails
+  loudly at the exact iteration (the reference's debug posture).
+"""
+
+from __future__ import annotations
+
+NUMERIC_POLICIES = ("off", "skip", "rollback", "abort")
+
+
+class NonFiniteStepError(RuntimeError):
+    """A training step produced a non-finite loss or gradient and the
+    numeric-guard policy wants the run stopped (``rollback`` — caught by
+    the optimizer's restore loop — or ``abort`` — surfaced to the
+    caller)."""
+
+    def __init__(self, step: int, loss: float, policy: str):
+        self.step = int(step)
+        self.loss = float(loss)
+        self.policy = policy
+        super().__init__(
+            f"non-finite training step at iteration {step} "
+            f"(loss={loss}); numeric_guard policy is {policy!r}")
+
+
+def validate_policy(policy: str, source: str = "numeric_guard") -> str:
+    if policy not in NUMERIC_POLICIES:
+        raise ValueError(
+            f"{source} must be one of {NUMERIC_POLICIES}, got {policy!r}")
+    return policy

@@ -1,0 +1,538 @@
+"""ReplicaSet — self-healing replica-per-device serving.
+
+ROADMAP serving item 1a + the resilience layer: one
+:class:`~bigdl_tpu.serving.InferenceService` (own bounded queue, own
+batcher thread, own AOT bucket executables) per device, fronted by a
+router that makes replica failure a routing event instead of an outage
+(reference: BigDL 2.0 Cluster Serving's per-replica failure isolation
+and backpressure, arXiv:2204.01715 §3.3).
+
+Contract:
+
+- **Least-queue-depth dispatch.**  Each request goes to the admitted
+  replica with the shallowest queue (ties break on the lowest index —
+  deterministic).  On an 8-chip host this is the 8× fan-out of one
+  ``ModelRegistry`` entry; on a CPU host N replicas emulate the topology
+  on one device (how the tier-1 tests and ``bench.py --resilience``
+  exercise every path below).
+- **Per-request deadlines, propagated.**  ``deadline_ms`` stamps each
+  request with a monotonic deadline that travels WITH it through the
+  replica's queue (``serving/batcher._Request.deadline``): the batcher
+  refuses to dispatch expired work, and the supervisor fails requests
+  stuck on a wedged/dead replica so the router can move them.
+- **Bounded retry — inference is idempotent.**  A failed or timed-out
+  request is retried on a different healthy replica up to
+  ``max_retries`` times while its deadline allows.  An accepted request
+  is therefore never silently dropped: it resolves with a result or an
+  explicit error (gated in ``tests/test_resilience.py`` and the
+  subprocess kill test).
+- **Health state machine per replica** (``resilience/health.py``):
+  failures degrade → quarantine; a quarantined replica gets zero
+  traffic until its probation probe (exponential backoff + seeded
+  jitter) succeeds.  A replica whose batcher thread DIED is detected by
+  the supervisor (liveness poll — the one place in the serving stack
+  that polls, because a dead thread cannot notify), quarantined
+  immediately, its stranded requests failed over, and its batcher
+  **revived** (fresh thread over the same warmed executables —
+  ``InferenceService.revive``) so probation has something to probe.
+- **Queue-pressure load shedding.**  When no admitted replica can take
+  the request (all queues full, or everything quarantined), the set
+  sheds with :class:`~bigdl_tpu.serving.ServiceOverloaded` carrying a
+  ``retry_after_ms`` hint (queue drain rate when queues are the
+  problem, next probation window when health is).
+
+All events flow into one :class:`~bigdl_tpu.telemetry.registry.
+MetricRegistry` (``resilience/*`` counters) and, when given, a tracer
+(instant events per quarantine/readmission/failover).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, List, Optional, Sequence
+
+from bigdl_tpu.resilience.faults import FaultInjector
+from bigdl_tpu.resilience.health import (PROBE, QUARANTINED,
+                                         HealthPolicy, ReplicaHealth)
+from bigdl_tpu.serving.batcher import (DeadlineExceeded, ServiceClosed,
+                                       ServiceOverloaded,
+                                       settle_future as _settle)
+from bigdl_tpu.serving.service import InferenceService
+from bigdl_tpu.telemetry.registry import MetricRegistry
+
+logger = logging.getLogger("bigdl_tpu.resilience")
+
+
+class ReplicaDeadError(RuntimeError):
+    """The replica holding this request died (batcher thread gone) —
+    the supervisor resolves the stranded future with this so the router
+    can fail over."""
+
+
+class _Route:
+    """Caller-facing request state: the outer future plus the retry
+    budget.  One _Route may span several replica attempts;
+    ``last_exc`` remembers the most recent attempt's real failure so
+    running out of replicas surfaces THAT, not a fabricated shed."""
+
+    __slots__ = ("x", "outer", "deadline", "tries_left", "tried",
+                 "last_exc")
+
+    def __init__(self, x, outer: Future, deadline: Optional[float],
+                 tries_left: int):
+        self.x = x
+        self.outer = outer
+        self.deadline = deadline
+        self.tries_left = tries_left
+        self.tried: set = set()
+        self.last_exc: Optional[BaseException] = None
+
+
+class ReplicaSet:
+    """N replicas of one model behind least-queue-depth routing with
+    health tracking, failover and load shedding.  See module docstring.
+
+    Parameters beyond the :class:`InferenceService` knobs:
+
+    - ``n_replicas``: replica count; default one per local device.
+      More replicas than devices is legal (emulated replicas — they
+      round-robin over ``devices``).
+    - ``devices``: placement targets; default ``jax.local_devices()``.
+      Each replica's params/state are ``device_put`` onto its device so
+      its dispatches run there (replica-per-chip routing).
+    - ``deadline_ms``: per-request deadline (default
+      ``Config.serving_deadline_ms``; 0 = none).
+    - ``max_retries``: failover budget per request (attempts = 1 +
+      max_retries).
+    - ``health``: a :class:`HealthPolicy` (thresholds/probation
+      backoff) shared by all replicas.
+    - ``registry`` / ``tracer``: where resilience events land.
+    """
+
+    _SUPERVISOR_POLL_S = 0.02  # liveness/deadline sweep while inflight
+
+    def __init__(self, model, params=None, state=None, *,
+                 n_replicas: Optional[int] = None,
+                 devices: Optional[Sequence] = None,
+                 input_spec=None, max_batch_size: Optional[int] = None,
+                 batch_timeout_ms: Optional[float] = None,
+                 queue_capacity: Optional[int] = None, buckets=None,
+                 workload: Optional[str] = None, name: str = "model",
+                 deadline_ms: Optional[float] = None,
+                 max_retries: int = 2,
+                 health: Optional[HealthPolicy] = None,
+                 fault_injector: Optional[FaultInjector] = None,
+                 registry: Optional[MetricRegistry] = None,
+                 tracer=None, start: bool = True):
+        import jax
+
+        self.name = name
+        self.registry = registry if registry is not None \
+            else MetricRegistry()
+        self.tracer = tracer
+        self.max_retries = max(0, int(max_retries))
+        if deadline_ms is None:
+            # the same explicit > env > tuned[workload] > default chain
+            # the other serving knobs resolve through
+            from bigdl_tpu.engine import Engine
+            deadline_ms = Engine.serving_defaults(workload)["deadline_ms"]
+        self.deadline_s = (float(deadline_ms) / 1e3
+                           if deadline_ms and deadline_ms > 0 else None)
+        if fault_injector is None:
+            fault_injector = FaultInjector.from_config(
+                registry=self.registry)
+        else:
+            fault_injector.attach_registry(self.registry)
+        self._faults = fault_injector
+
+        if devices is None:
+            devices = jax.local_devices()
+        if n_replicas is None:
+            n_replicas = len(devices)
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1: {n_replicas}")
+        if params is None:
+            model._ensure_init()
+            params, state = model._params, model._state
+        state = state if state is not None else {}
+
+        policy = health or HealthPolicy()
+        self._replicas: List[InferenceService] = []
+        self._health: List[ReplicaHealth] = []
+        for i in range(int(n_replicas)):
+            dev = devices[i % len(devices)]
+            # committed per-device placement: the replica's jit follows
+            # its params' device, so replica i's dispatches run on chip
+            # i%D — the replica-per-chip routing of ROADMAP 1a
+            p_i = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, dev), params)
+            s_i = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, dev), state)
+            svc = InferenceService(
+                model, p_i, s_i, input_spec=input_spec,
+                max_batch_size=max_batch_size,
+                batch_timeout_ms=batch_timeout_ms,
+                queue_capacity=queue_capacity, buckets=buckets,
+                workload=workload, name=f"{name}/r{i}",
+                start=start, fault_injector=self._faults)
+            svc._fault_replica = i
+            self._replicas.append(svc)
+            self._health.append(ReplicaHealth(
+                i, policy=policy, registry=self.registry))
+
+        # counters created eagerly so a zero-event run still snapshots
+        # the full schema
+        for c in ("failovers", "sheds", "quarantines",
+                  "readmissions", "probes", "degradations",
+                  "deadline_timeouts", "replica_deaths", "revivals"):
+            self.registry.counter(f"resilience/{c}")
+
+        self._lock = threading.Lock()
+        # one death handler may run per replica at a time: routing and
+        # the supervisor can both spot the same dead batcher, and a
+        # double-revive would double-count the death in the metrics
+        self._death_locks = [threading.Lock()
+                             for _ in range(len(self._replicas))]
+        self._inflight: dict = {}  # token -> (route, ix, inner, probe)
+        self._token = itertools.count()
+        self._stopped = False
+        self._supervisor: Optional[threading.Thread] = None
+        self._wake = threading.Condition(self._lock)
+
+    # ------------------------------------------------------------ events
+    def _instant(self, event: str, **args) -> None:
+        if self.tracer is not None:
+            self.tracer.instant(event, cat="resilience", **args)
+
+    # ----------------------------------------------------------- routing
+    def _pick(self, route: _Route):
+        """(replica_ix, probe?) of the admitted replica with the
+        shallowest queue, or None.  Dead replicas found here are
+        quarantined + revived on the spot (routing-time liveness — the
+        supervisor only watches replicas with inflight work).
+
+        ``admit()`` on a quarantined replica CONSUMES its one probation
+        probe slot, so it may only be asked once a replica is actually
+        selected — asking every candidate and dispatching one would
+        leak ``_probe_inflight`` on the rest and quarantine them
+        forever.  Hence two passes: quarantined replicas first (a due
+        probe is preferred — re-admission must make progress under
+        sustained load; at most ONE admit() call, on the selected
+        replica), then least-queue-depth over the healthy rest."""
+        now = time.monotonic()
+        eligible = []
+        for i, svc in enumerate(self._replicas):
+            if i in route.tried:
+                continue
+            if not svc.alive:
+                self._on_replica_dead(i)
+                continue
+            eligible.append((i, svc))
+        for i, svc in eligible:
+            if self._health[i].state == QUARANTINED:
+                if self._health[i].admit(now) == PROBE:
+                    return i, True
+        candidates = [(svc.queue_depth(), i) for i, svc in eligible
+                      if self._health[i].state != QUARANTINED]
+        if not candidates:
+            return None
+        candidates.sort()
+        return candidates[0][1], False
+
+    def _shed(self, route: _Route, initial: bool,
+              last_overload: Optional[ServiceOverloaded]) -> None:
+        """No admissible replica: shed with a retry-after hint — the
+        queue drain estimate when queues are the problem, the next
+        probation window when health is."""
+        self.registry.counter("resilience/sheds").inc()
+        self._instant("shed", model=self.name)
+        if last_overload is not None:
+            retry_ms = last_overload.retry_after_ms
+            depth, cap = last_overload.queue_depth, last_overload.capacity
+        else:
+            waits = [h.next_probe_in() for h in self._health
+                     if h.state == "quarantined"]
+            retry_ms = round(min(waits) * 1e3, 1) if waits else None
+            depth = sum(s.queue_depth() for s in self._replicas)
+            cap = sum(s.queue_capacity for s in self._replicas)
+        exc = ServiceOverloaded(depth, cap, self.name,
+                                retry_after_ms=retry_ms)
+        if initial:
+            raise exc
+        _settle(route.outer, exc=exc)
+
+    def _attempt(self, route: _Route, initial: bool = False) -> None:
+        """Submit one attempt.  Runs on the caller thread (initial) or a
+        replica batcher/supervisor thread (failover) — everything here
+        is lock-cheap, no device work."""
+        last_overload: Optional[ServiceOverloaded] = None
+        while True:
+            if route.outer.done():
+                return  # caller cancelled / already settled
+            picked = self._pick(route)
+            if picked is None:
+                if route.last_exc is not None:
+                    # every replica was tried and the last one FAILED —
+                    # that failure is the diagnosis, not overload: a
+                    # deterministic model bug reported as a shed would
+                    # send callers into a futile retry-after loop
+                    _settle(route.outer, exc=route.last_exc)
+                    return
+                self._shed(route, initial, last_overload)
+                return
+            ix, probe = picked
+            svc = self._replicas[ix]
+            try:
+                inner = svc.submit(route.x, deadline=route.deadline)
+            except ServiceOverloaded as e:
+                last_overload = e
+                if probe:
+                    # the probe never ran — release its slot without an
+                    # outcome so the replica stays probe-able
+                    self._health[ix].cancel_probe()
+                route.tried.add(ix)  # full queue: look elsewhere (not a
+                continue             # health failure)
+            except ServiceClosed:
+                if probe:
+                    self._health[ix].cancel_probe()
+                self._on_replica_dead(ix)
+                route.tried.add(ix)
+                continue
+            except Exception as e:  # malformed request et al: caller bug
+                if probe:
+                    # the replica never saw the request — release the
+                    # probe without an outcome (a caller bug must not
+                    # extend someone else's quarantine)
+                    self._health[ix].cancel_probe()
+                if initial:
+                    raise
+                _settle(route.outer, exc=e)
+                return
+            token = next(self._token)
+            with self._lock:
+                self._inflight[token] = (route, ix, inner, probe)
+                self._ensure_supervisor_locked()
+                self._wake.notify_all()
+            inner.add_done_callback(
+                lambda _f, _t=token: self._on_done(_t))
+            return
+
+    # -------------------------------------------------------- completion
+    def _on_done(self, token) -> None:
+        with self._lock:
+            entry = self._inflight.pop(token, None)
+        if entry is None:
+            return
+        route, ix, inner, probe = entry
+        health = self._health[ix]
+        if inner.cancelled():
+            exc: Optional[BaseException] = ServiceClosed(
+                f"replica {ix} cancelled the request")
+        else:
+            exc = inner.exception()
+        if exc is None:
+            health.record_success(probe=probe)
+            if probe:
+                self._instant("readmission_probe_ok", replica=ix)
+            _settle(route.outer, result=inner.result())
+            return
+        # failure: classify, record, maybe fail over
+        if isinstance(exc, ReplicaDeadError):
+            pass  # _on_replica_dead already recorded it
+        elif isinstance(exc, DeadlineExceeded):
+            self.registry.counter("resilience/deadline_timeouts").inc()
+            if getattr(exc, "wedged", False):
+                # the SUPERVISOR resolved it: the batcher missed its
+                # own deadline window — evidence against the replica
+                health.record_failure(probe=probe)
+            elif probe:
+                # the batcher itself refused expired work: the replica
+                # is alive and draining, the queue was just long —
+                # congestion is not a poison signal (the breaker
+                # contract, applied to replica health: a deadline storm
+                # under pure overload must not cascade-quarantine the
+                # set).  Release the probe without an outcome.
+                health.cancel_probe()
+        else:
+            health.record_failure(probe=probe)
+        if probe:
+            self._instant("readmission_probe_failed", replica=ix)
+        now = time.monotonic()
+        out_of_time = route.deadline is not None and now >= route.deadline
+        if route.tries_left > 0 and not out_of_time \
+                and not route.outer.done():
+            route.tries_left -= 1
+            route.tried.add(ix)
+            route.last_exc = exc  # surfaced if no replica is left
+            self.registry.counter("resilience/failovers").inc()
+            self._instant("failover", replica=ix,
+                          error=type(exc).__name__)
+            self._attempt(route)
+            return
+        _settle(route.outer, exc=exc)
+
+    # -------------------------------------------------------- supervisor
+    def _ensure_supervisor_locked(self) -> None:
+        if self._supervisor is None or not self._supervisor.is_alive():
+            self._supervisor = threading.Thread(
+                target=self._supervise, name=f"{self.name}-supervisor",
+                daemon=True)
+            self._supervisor.start()
+
+    def _supervise(self) -> None:
+        """Liveness + stuck-request sweep.  The batcher itself honors
+        deadlines for work it actually dispatches; this loop exists for
+        the work a batcher can no longer dispatch — dead thread, wedged
+        straggler — where only an outside observer can resolve the
+        future.  Polling is unavoidable here (a dead thread cannot
+        notify); the poll only runs while requests are in flight."""
+        grace = self._SUPERVISOR_POLL_S
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                if not self._inflight:
+                    self._wake.wait(timeout=1.0)
+                    continue
+                entries = list(self._inflight.items())
+            now = time.monotonic()
+            dead = set()
+            for token, (route, ix, inner, probe) in entries:
+                if inner.done():
+                    continue
+                if not self._replicas[ix].alive:
+                    dead.add(ix)
+                    _settle(inner, exc=ReplicaDeadError(
+                        f"replica {ix} of {self.name!r} died with this "
+                        f"request in flight"))
+                elif route.deadline is not None \
+                        and now >= route.deadline + grace:
+                    # expired without the batcher resolving it: settle
+                    # from outside.  Tagged `wedged` — evidence against
+                    # the replica — ONLY when the batcher has made no
+                    # dispatch progress since the deadline passed; a
+                    # batcher that is actively draining just has a
+                    # queue longer than the deadline (congestion, not
+                    # poison — it will refuse this request itself soon,
+                    # and under a pure overload storm the supervisor
+                    # must not cascade-quarantine healthy replicas)
+                    progress = self._replicas[ix].last_progress
+                    exc = DeadlineExceeded(
+                        f"request deadline exceeded on replica {ix}")
+                    exc.wedged = (progress is None
+                                  or progress < route.deadline)
+                    _settle(inner, exc=exc)
+            for ix in dead:
+                self._on_replica_dead(ix)
+            with self._lock:
+                if self._stopped:
+                    return
+                self._wake.wait(timeout=self._SUPERVISOR_POLL_S)
+
+    def _on_replica_dead(self, ix: int) -> None:
+        """Quarantine + revive a replica whose batcher thread died.
+        Idempotent per death: revive() is a no-op on a running batcher."""
+        svc = self._replicas[ix]
+        with self._death_locks[ix]:
+            if svc.alive or self._stopped:
+                return  # someone else already revived it (or shutdown)
+            self.registry.counter("resilience/replica_deaths").inc()
+            self._health[ix].mark_dead()
+            self._instant("replica_death", replica=ix)
+            logger.warning("replica %d of %r died; quarantined, "
+                           "reviving", ix, self.name)
+            try:
+                svc.revive()
+                self.registry.counter("resilience/revivals").inc()
+            except Exception:
+                logger.exception("replica %d revive failed; it stays "
+                                 "quarantined until the next probe", ix)
+
+    # --------------------------------------------------------------- api
+    def submit(self, x, *, timeout: Optional[float] = None) -> Future:
+        """Route one request (≤ max_batch_size rows).  Returns a Future
+        that ALWAYS resolves: result, explicit error, or
+        ``ServiceOverloaded``/``DeadlineExceeded``.  ``timeout`` (or the
+        set-level ``deadline_ms``) bounds the whole request including
+        failovers."""
+        if self._stopped:
+            raise ServiceClosed(f"replica set {self.name!r} is stopped")
+        deadline_s = (timeout if timeout is not None else self.deadline_s)
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        route = _Route(x, Future(), deadline, self.max_retries)
+        self._attempt(route, initial=True)
+        return route.outer
+
+    def predict(self, x, timeout: Optional[float] = None):
+        """Blocking sugar over :meth:`submit`."""
+        fut = self.submit(x, timeout=timeout)
+        # the route deadline already bounds the future when set; the
+        # extra result() timeout is a belt against a supervisor gap.
+        # Its expiry is normalized to DeadlineExceeded — on py<3.11
+        # concurrent.futures.TimeoutError is NOT builtin TimeoutError,
+        # and callers must not need to know which timeout fired
+        wait = timeout if timeout is not None else None
+        try:
+            return fut.result(wait)
+        except FutureTimeoutError:
+            if fut.done():
+                # the future RESOLVED with its own timeout-family
+                # error (DeadlineExceeded is a TimeoutError, and on
+                # py>=3.11 FutureTimeoutError aliases it) — propagate
+                # the real diagnosis untouched
+                raise
+            raise DeadlineExceeded(
+                f"request to {self.name!r} still unresolved after a "
+                f"{wait:.3f}s result wait" if wait is not None else
+                f"request to {self.name!r} never resolved") from None
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    def replica(self, ix: int) -> InferenceService:
+        return self._replicas[ix]
+
+    def health_states(self) -> List[str]:
+        return [h.state for h in self._health]
+
+    def start(self) -> None:
+        for svc in self._replicas:
+            svc.start()
+
+    def stats(self) -> dict:
+        """Set-level snapshot: per-replica service stats + health, plus
+        the resilience counters."""
+        return {
+            "model": self.name,
+            "replicas": [
+                {"ix": i, "alive": svc.alive,
+                 "health": self._health[i].snapshot(),
+                 **svc.stats()}
+                for i, svc in enumerate(self._replicas)],
+            "resilience": self.registry.snapshot()["counters"],
+        }
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._wake.notify_all()
+        for svc in self._replicas:
+            svc.stop(drain=drain, timeout=timeout)
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=2.0)
+
+    def __enter__(self) -> "ReplicaSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=True)

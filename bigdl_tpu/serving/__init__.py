@@ -27,7 +27,7 @@ reference repo's ``PredictionService.scala`` — whose Python twin in
 """
 
 from bigdl_tpu.serving.batcher import (
-    RequestBatcher, ServiceClosed, ServiceOverloaded,
+    DeadlineExceeded, RequestBatcher, ServiceClosed, ServiceOverloaded,
 )
 from bigdl_tpu.serving.metrics import LatencyReservoir, ServingMetrics
 from bigdl_tpu.serving.registry import ModelRegistry
@@ -35,6 +35,6 @@ from bigdl_tpu.serving.service import InferenceService, pad_rows, row_buckets
 
 __all__ = [
     "InferenceService", "ModelRegistry", "RequestBatcher",
-    "ServiceClosed", "ServiceOverloaded", "ServingMetrics",
-    "LatencyReservoir", "row_buckets",
+    "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
+    "ServingMetrics", "LatencyReservoir", "row_buckets",
 ]

@@ -37,36 +37,74 @@ class ServiceOverloaded(RuntimeError):
     """Bounded request queue is full — shed load upstream.
 
     Carries ``queue_depth`` / ``capacity`` so callers (and error pages)
-    can report how far behind the service is.
+    can report how far behind the service is, and ``retry_after_ms`` —
+    an estimate (from the batcher's observed queue drain rate) of when
+    the queue will have room again, so shed callers can back off a
+    useful amount instead of guessing.  ``None`` when the batcher has
+    not dispatched anything yet.
     """
 
-    def __init__(self, queue_depth: int, capacity: int, model: str = ""):
+    def __init__(self, queue_depth: int, capacity: int, model: str = "",
+                 retry_after_ms: Optional[float] = None):
         self.queue_depth = queue_depth
         self.capacity = capacity
         self.model = model
+        self.retry_after_ms = retry_after_ms
         tag = f" model={model!r}" if model else ""
+        hint = (f"; retry_after_ms={retry_after_ms:.1f}"
+                if retry_after_ms is not None else "")
         super().__init__(
             f"serving queue full{tag}: depth={queue_depth} "
-            f"capacity={capacity} — backpressure; retry with backoff "
-            f"or raise queue_capacity")
+            f"capacity={capacity}{hint} — backpressure; retry with "
+            f"backoff or raise queue_capacity")
 
 
 class ServiceClosed(RuntimeError):
     """submit() after close() — the service no longer accepts work."""
 
 
+def settle_future(fut: Future, *, result=None,
+                  exc: Optional[BaseException] = None) -> bool:
+    """Resolve a request future, tolerating the race where someone
+    else got there first (a late batcher completion vs. the ReplicaSet
+    supervisor timing out or failing over the same request).  Returns
+    whether THIS call settled it — callers gate their per-request
+    accounting on that, so a request served after being failed over is
+    not double-counted.  The ONE such helper; service.py and
+    resilience/replica_set.py both use it."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+        return True
+    except Exception:  # InvalidStateError: already resolved — benign
+        return False
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before (or while) it could be
+    served.  Set on the request's future by the dispatch path (expired
+    work is refused before the device call) or by an outside supervisor
+    (work stuck on a dead/wedged replica).  Inference is idempotent, so
+    a router may retry the same request elsewhere."""
+
+
 class _Request:
     """One enqueued inference request: a pytree of np arrays with a
     shared leading row dim ``n_rows`` (≤ max_batch_size, enforced by the
-    service) plus the future the caller is waiting on."""
+    service) plus the future the caller is waiting on.  ``deadline``
+    (monotonic seconds, or None) travels WITH the request through the
+    queue — the dispatch path refuses expired work."""
 
-    __slots__ = ("x", "n_rows", "future", "t_enqueue")
+    __slots__ = ("x", "n_rows", "future", "t_enqueue", "deadline")
 
-    def __init__(self, x, n_rows: int):
+    def __init__(self, x, n_rows: int, deadline: Optional[float] = None):
         self.x = x
         self.n_rows = n_rows
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
+        self.deadline = deadline
 
 
 class RequestBatcher:
@@ -109,16 +147,44 @@ class RequestBatcher:
         self._drain = True
         self._thread: Optional[threading.Thread] = None
         self.cancelled_rows = 0
+        # EWMA of seconds-per-request through dispatch, written only by
+        # the batcher thread (reads are racy-by-design: a hint, not an
+        # invariant) — feeds ServiceOverloaded.retry_after_ms
+        self._spr_ewma: Optional[float] = None
+        # monotonic time of the last completed dispatch (or start()) —
+        # the liveness signal an outside supervisor uses to tell a
+        # WEDGED batcher (no progress) from a congested one (draining,
+        # just slower than the deadline).  Racy-by-design single write.
+        self.last_progress: Optional[float] = None
 
     # -- producer side -----------------------------------------------------
+    def retry_after_ms(self, depth: Optional[int] = None) -> Optional[float]:
+        """How long (ms) until the current backlog should have drained,
+        from the observed dispatch rate.  None before the first
+        dispatch (no rate to estimate from)."""
+        spr = self._spr_ewma
+        if spr is None:
+            return None
+        if depth is None:
+            depth = len(self._q)
+        return round(min(max(depth * spr * 1e3, 1.0), 10_000.0), 1)
+
+    def _note_dispatch(self, n_requests: int, elapsed_s: float) -> None:
+        spr = elapsed_s / max(1, n_requests)
+        prev = self._spr_ewma
+        self._spr_ewma = spr if prev is None else 0.7 * prev + 0.3 * spr
+        self.last_progress = time.monotonic()
+
     def put(self, req: _Request) -> None:
         with self._cond:
             if self._closed:
                 raise ServiceClosed(
                     f"serving endpoint {self._name!r} is stopped")
             if len(self._q) >= self.queue_capacity:
-                raise ServiceOverloaded(len(self._q), self.queue_capacity,
-                                        self._name)
+                depth = len(self._q)
+                raise ServiceOverloaded(
+                    depth, self.queue_capacity, self._name,
+                    retry_after_ms=self.retry_after_ms(depth))
             self._q.append(req)
             self._cond.notify_all()
 
@@ -131,6 +197,7 @@ class RequestBatcher:
         """Idempotent; tests construct services with ``start=False`` to
         stage a queue deterministically before the first dispatch."""
         if self._thread is None:
+            self.last_progress = time.monotonic()
             self._thread = threading.Thread(
                 target=self._run, name=f"{self._name}-batcher", daemon=True)
             self._thread.start()
@@ -139,6 +206,18 @@ class RequestBatcher:
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
+    @property
+    def dead(self) -> bool:
+        """The batcher thread was started and has DIED without
+        ``close()`` — a crashed dispatch (or an injected
+        ``ReplicaDeathFault``) took it down, so queued work can no
+        longer dispatch.  Distinct from ``running=False`` before
+        ``start()`` (a parked batcher can still be started) and from a
+        closed batcher (an orderly stop is not a death).  This is the
+        liveness the ``ReplicaSet`` supervisor polls."""
+        return (self._thread is not None
+                and not self._thread.is_alive() and not self._closed)
+
     def close(self, drain: bool = True,
               timeout: Optional[float] = None) -> int:
         """Refuse new work; drain (default) or cancel the backlog; join
@@ -146,11 +225,19 @@ class RequestBatcher:
         never-started batcher (the backlog is then resolved inline).
         Returns the number of ROWS cancelled (0 when draining)."""
         with self._cond:
+            was_dead = self.dead
             self._closed = True
             self._drain = drain
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout)
+            if was_dead or not self._thread.is_alive():
+                # a CRASHED batcher can neither drain nor cancel its
+                # own backlog, and inline-dispatching on the caller's
+                # thread could re-raise whatever killed it — cancel the
+                # remainder so no accepted future is left dangling
+                # (no-op after an orderly drain: the queue is empty)
+                self._cancel_backlog()
             return self.cancelled_rows
         # batcher never ran: resolve the backlog on the caller's
         # thread so no accepted future is left dangling
@@ -177,12 +264,19 @@ class RequestBatcher:
                 return
             self._dispatch_fn(batch)
 
+    def _dispatch_timed(self, batch: List[_Request]) -> None:
+        t0 = time.monotonic()
+        try:
+            self._dispatch_fn(batch)
+        finally:
+            self._note_dispatch(len(batch), time.monotonic() - t0)
+
     # -- batcher thread ----------------------------------------------------
     def _run(self) -> None:
         while True:
             batch = self._collect(block=True)
             if batch:
-                self._dispatch_fn(batch)
+                self._dispatch_timed(batch)
                 continue
             # empty collect while blocking only happens when closed
             with self._cond:

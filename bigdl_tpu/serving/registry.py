@@ -9,14 +9,33 @@ own stats), deployable either from an in-memory Module or straight from
 the interop wire formats (BigDL / Caffe / TF / Keras / Torch — the same
 loaders ``interop.convert_model`` uses), optionally int8-quantized via
 ``nn.quantized.quantize`` on the way in.
+
+Resilience: every deployed version carries a
+:class:`~bigdl_tpu.resilience.health.CircuitBreaker`.  Latest-wins
+routing consults it — ``breaker_trip_after`` consecutive request
+failures on the newest version open its breaker and un-versioned
+``get``/``predict``/``submit`` calls fall back to the newest version
+whose breaker still admits traffic, so a poisoned deploy stops eating
+the error budget within a handful of requests instead of until a human
+rolls back.  After ``breaker_cooldown_s`` the tripped version goes
+half-open: the next routed request is its trial (success closes the
+breaker, failure re-trips with a doubled cooldown).  Overload/closed
+rejections are never counted — a full queue says nothing about whether
+the model is poisoned.  Pinned ``version=`` requests bypass the breaker
+(the caller asked for that version, they get its errors).
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from bigdl_tpu.resilience.health import CircuitBreaker
+from bigdl_tpu.serving.batcher import ServiceClosed, ServiceOverloaded
 from bigdl_tpu.serving.service import InferenceService
+
+logger = logging.getLogger("bigdl_tpu.serving")
 
 
 def _load_model(fmt: str, path: str, *, prototxt: Optional[str] = None,
@@ -63,12 +82,20 @@ class ModelRegistry:
     change.  ``undeploy`` drains the service before dropping it.
     """
 
-    def __init__(self):
+    def __init__(self, *, breaker_trip_after: int = 5,
+                 breaker_cooldown_s: float = 30.0, registry=None):
         self._lock = threading.Lock()
         self._services: Dict[Tuple[str, int], InferenceService] = {}
         self._latest: Dict[str, int] = {}
         # keys mid-deploy (reserved before the slow AOT warmup)
         self._pending: set[Tuple[str, int]] = set()
+        # per-version circuit breakers (see module docstring); the
+        # optional MetricRegistry receives resilience/breaker_trips and
+        # resilience/breaker_fallbacks counters
+        self._breaker_trip_after = int(breaker_trip_after)
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        self._metrics = registry
+        self._breakers: Dict[Tuple[str, int], CircuitBreaker] = {}
 
     # -- deployment --------------------------------------------------------
     def deploy(self, name: str, model=None, *, path: Optional[str] = None,
@@ -118,6 +145,10 @@ class ModelRegistry:
         with self._lock:
             self._pending.discard(key)
             self._services[key] = service
+            self._breakers[key] = CircuitBreaker(
+                trip_after=self._breaker_trip_after,
+                cooldown_s=self._breaker_cooldown_s,
+                registry=self._metrics, name=f"{name}:v{version}")
             self._latest[name] = max(self._latest.get(name, 0),
                                      int(version))
         return service
@@ -125,18 +156,58 @@ class ModelRegistry:
     # -- lookup ------------------------------------------------------------
     def _resolve(self, name: str, version: Optional[int]) -> Tuple[str, int]:
         """Caller must hold ``self._lock`` (so error paths below must
-        not re-take it — ``self._lock`` is not reentrant)."""
+        not re-take it — ``self._lock`` is not reentrant).
+
+        Latest-wins routing (``version=None``) consults the per-version
+        circuit breakers: versions are tried newest-first and the first
+        whose breaker admits traffic wins, so a poisoned newest deploy
+        falls back to the previous version while its breaker cools
+        down.  When EVERY breaker is open the newest version is used
+        anyway — serving a maybe-poisoned model beats serving nothing,
+        and its next failure just re-trips."""
         if version is None:
             if name not in self._latest:
                 raise KeyError(f"no model {name!r} deployed; have "
                                f"{sorted(self._latest)}")
-            version = self._latest[name]
+            newest = self._latest[name]
+            version = newest
+            for v in sorted((v for (n, v) in self._services if n == name),
+                            reverse=True):
+                brk = self._breakers.get((name, v))
+                if brk is None or brk.allow():
+                    version = v
+                    break
+            if version != newest:
+                if self._metrics is not None:
+                    self._metrics.counter(
+                        "resilience/breaker_fallbacks").inc()
+                logger.warning(
+                    "model %r v%d breaker open — routing to v%d",
+                    name, newest, version)
         key = (name, int(version))
         if key not in self._services:
             have = sorted(v for (n, v) in self._services if n == name)
             raise KeyError(f"model {name!r} has no version {version}; "
                            f"deployed: {have}")
         return key
+
+    def _routed(self, name: str, version: Optional[int]):
+        with self._lock:
+            key = self._resolve(name, version)
+            return self._services[key], self._breakers.get(key)
+
+    @staticmethod
+    def _record_outcome(brk: Optional[CircuitBreaker],
+                        exc: Optional[BaseException]) -> None:
+        """Feed one request outcome to the served version's breaker.
+        Overload/closed rejections say nothing about model poisoning
+        (documented breaker contract) — they are not recorded at all."""
+        if brk is None:
+            return
+        if exc is None:
+            brk.record_success()
+        elif not isinstance(exc, (ServiceOverloaded, ServiceClosed)):
+            brk.record_failure()
 
     def get(self, name: str,
             version: Optional[int] = None) -> InferenceService:
@@ -145,10 +216,30 @@ class ModelRegistry:
 
     def predict(self, name: str, x, version: Optional[int] = None,
                 timeout: Optional[float] = None):
-        return self.get(name, version).predict(x, timeout=timeout)
+        svc, brk = self._routed(name, version)
+        try:
+            out = svc.predict(x, timeout=timeout)
+        except BaseException as e:
+            self._record_outcome(brk, e)
+            raise
+        self._record_outcome(brk, None)
+        return out
 
     def submit(self, name: str, x, version: Optional[int] = None):
-        return self.get(name, version).submit(x)
+        svc, brk = self._routed(name, version)
+        fut = svc.submit(x)  # an overload raises here — never recorded
+        # a CANCELLED future is no outcome at all: the version never
+        # served the request, so it earns neither a success (which
+        # would reset a poisoned deploy's failure streak) nor a failure
+        fut.add_done_callback(
+            lambda f, _b=brk: None if f.cancelled()
+            else self._record_outcome(_b, f.exception()))
+        return fut
+
+    def breaker_state(self, name: str, version: int) -> dict:
+        """Snapshot of one version's circuit breaker (tests/dashboards)."""
+        with self._lock:
+            return self._breakers[(name, int(version))].snapshot()
 
     def list_models(self) -> Dict[str, List[int]]:
         with self._lock:
@@ -170,6 +261,8 @@ class ModelRegistry:
             else:
                 keys = [self._resolve(name, version)]
             doomed = [self._services.pop(k) for k in keys]
+            for k in keys:
+                self._breakers.pop(k, None)
             remaining = [v for (n, v) in self._services if n == name]
             if remaining:
                 self._latest[name] = max(remaining)
@@ -183,13 +276,17 @@ class ModelRegistry:
         registry-wide snapshot a metrics scraper exports."""
         with self._lock:
             services = dict(self._services)
-        return {f"{n}:v{v}": svc.stats()
+            breakers = dict(self._breakers)
+        return {f"{n}:v{v}": {**svc.stats(),
+                              "breaker": breakers[(n, v)].snapshot()
+                              if (n, v) in breakers else None}
                 for (n, v), svc in sorted(services.items())}
 
     def stop_all(self, drain: bool = True) -> None:
         with self._lock:
             services = list(self._services.values())
             self._services.clear()
+            self._breakers.clear()
             self._latest.clear()
         for svc in services:
             svc.stop(drain=drain)

@@ -36,7 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.serving.batcher import (
-    RequestBatcher, ServiceClosed, ServiceOverloaded, _Request,
+    DeadlineExceeded, RequestBatcher, ServiceClosed, ServiceOverloaded,
+    _Request, settle_future,
 )
 from bigdl_tpu.serving.metrics import ServingMetrics
 
@@ -153,6 +154,12 @@ class InferenceService:
         requests queue (bounded) until :meth:`start`.  Used by tests to
         stage deterministic coalescing, and by deploys that want warmup
         strictly before traffic.
+    fault_injector:
+        Optional :class:`~bigdl_tpu.resilience.faults.FaultInjector`
+        consulted once per coalesced dispatch (keyed by this service's
+        own dispatch counter) — the chaos hook the resilience tests and
+        ``bench.py --resilience`` drive.  ``None`` (the default) is the
+        provably-inert state: the dispatch path never touches it.
     """
 
     def __init__(self, model, params=None, state=None, *,
@@ -160,7 +167,8 @@ class InferenceService:
                  batch_timeout_ms: Optional[float] = None,
                  queue_capacity: Optional[int] = None,
                  buckets=None, workload: Optional[str] = None,
-                 name: str = "model", start: bool = True):
+                 name: str = "model", start: bool = True,
+                 fault_injector=None):
         from bigdl_tpu.engine import Engine
         self.workload = workload
         defaults = Engine.serving_defaults(workload)
@@ -216,6 +224,21 @@ class InferenceService:
         self._warm_lock = threading.Lock()
         self._stopped = False
         self.metrics = ServingMetrics()
+        # fault injection (resilience layer): the injector is consulted
+        # per dispatch; _fault_replica is stamped by ReplicaSet so
+        # target= clauses can aim at one replica of a set
+        self._faults = fault_injector
+        self._fault_replica: Optional[int] = None
+        self._dispatch_index = 0
+        self._batcher = self._make_batcher()
+        self._finalizer = weakref.finalize(
+            self, RequestBatcher.close, self._batcher, True, 5.0)
+        if input_spec is not None:
+            self.warmup(input_spec)
+        if start:
+            self._batcher.start()
+
+    def _make_batcher(self) -> RequestBatcher:
         # a dropped service must not strand its batcher thread for the
         # life of the process (the historical PredictionService needed
         # no cleanup, so shim users never call stop()).  For the
@@ -237,16 +260,10 @@ class InferenceService:
                 return
             fn(requests)
 
-        self._batcher = RequestBatcher(
+        return RequestBatcher(
             dispatch, max_batch_size=self.max_batch_size,
             batch_timeout_ms=self.batch_timeout_ms,
-            queue_capacity=self.queue_capacity, name=name)
-        self._finalizer = weakref.finalize(
-            self, RequestBatcher.close, self._batcher, True, 5.0)
-        if input_spec is not None:
-            self.warmup(input_spec)
-        if start:
-            self._batcher.start()
+            queue_capacity=self.queue_capacity, name=self.name)
 
     # -- warmup ------------------------------------------------------------
     @staticmethod
@@ -372,12 +389,18 @@ class InferenceService:
                      for leaf, s in zip(req_leaves, spec_leaves)]
         return _tree.tree_unflatten(req_def, conformed)
 
-    def submit(self, x) -> Future:
+    def submit(self, x, *, deadline: Optional[float] = None) -> Future:
         """Enqueue one request (pytree of arrays, shared leading batch
         dim ``n`` with ``1 <= n <= max_batch_size``) and return the
         Future of its stacked outputs.  Raises
         :class:`ServiceOverloaded` when the bounded queue is full and
-        :class:`ServiceClosed` after :meth:`stop`."""
+        :class:`ServiceClosed` after :meth:`stop`.
+
+        ``deadline`` (absolute ``time.monotonic()`` seconds, or None)
+        travels WITH the request through the queue: the dispatch path
+        refuses expired work with :class:`DeadlineExceeded` instead of
+        burning device time on a caller that has given up — the
+        per-request deadline propagation ``ReplicaSet`` routes on."""
         xs, n = self._normalize_input(x)
         if n == 0:
             f: Future = Future()
@@ -387,6 +410,13 @@ class InferenceService:
             raise ValueError(
                 f"request of {n} rows exceeds max_batch_size="
                 f"{self.max_batch_size}; use predict() which chunks")
+        if deadline is not None and time.monotonic() >= deadline:
+            # already expired: resolve without ever touching the queue
+            f = Future()
+            f.set_exception(DeadlineExceeded(
+                f"request deadline passed before submit to "
+                f"{self.name!r}"))
+            return f
         if not self._warmed:
             # deferred-spec path: capture the row spec from live
             # traffic (warmup is lock-idempotent, so concurrent first
@@ -394,7 +424,7 @@ class InferenceService:
             self.warmup(_tree.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), xs))
         xs = self._conform_request(xs)
-        req = _Request(xs, n)
+        req = _Request(xs, n, deadline=deadline)
         try:
             self._batcher.put(req)
         except ServiceOverloaded:
@@ -464,11 +494,47 @@ class InferenceService:
     def _dispatch(self, requests: List[_Request]) -> None:
         """Runs on the batcher thread: coalesce → pad to bucket → one
         compiled call → slice per-request outputs → resolve futures."""
-        live = [r for r in requests if r.future.set_running_or_notify_cancel()]
+        live = []
+        for r in requests:
+            try:
+                if r.future.set_running_or_notify_cancel():
+                    live.append(r)
+            except Exception:
+                # already resolved from OUTSIDE the batcher (the
+                # ReplicaSet supervisor timing out / failing over a
+                # stuck request) — nothing left to serve here
+                pass
         if not live:
             return
+        now = time.monotonic()
+        expired = [r for r in live
+                   if r.deadline is not None and now >= r.deadline]
+        if expired:
+            # deadline propagation: refuse expired work BEFORE the
+            # device call — inference is idempotent, so the router may
+            # already have retried it on another replica
+            for r in expired:
+                if settle_future(r.future, exc=DeadlineExceeded(
+                        f"request expired in {self.name!r} queue after "
+                        f"{(now - r.t_enqueue) * 1e3:.1f} ms")):
+                    self.metrics.record_failure(r.n_rows)
+            live = [r for r in live
+                    if r.deadline is None or now < r.deadline]
+            if not live:
+                return
         rows = sum(r.n_rows for r in live)
         try:
+            if self._faults is not None:
+                # fault site — inside the handler, so an injected
+                # dispatch error resolves the group's futures like any
+                # real dispatch failure; ReplicaDeathFault is a
+                # BaseException and ESCAPES, killing this batcher
+                # thread with the group stranded, exactly like a real
+                # thread crash (the failure the ReplicaSet supervisor
+                # exists to detect)
+                ix = self._dispatch_index
+                self._dispatch_index += 1
+                self._faults.serving_dispatch(ix, self._fault_replica)
             if len(live) == 1:
                 x = live[0].x
             else:
@@ -494,18 +560,63 @@ class InferenceService:
             off = 0
             for r in live:
                 lo, hi = off, off + r.n_rows
-                r.future.set_result(
-                    _tree.tree_map(lambda o: o[lo:hi], out))
-                self.metrics.record_done(r.n_rows, now - r.t_enqueue,
-                                         bucket=bucket)
+                if settle_future(r.future, result=_tree.tree_map(
+                        lambda o: o[lo:hi], out)):
+                    # counted only when THIS dispatch settled it — a
+                    # straggler completing a request the supervisor
+                    # already failed over must not double-count it
+                    self.metrics.record_done(r.n_rows,
+                                             now - r.t_enqueue,
+                                             bucket=bucket)
                 off = hi
         except Exception as e:  # resolve, never strand, the waiters
             for r in live:
                 if not r.future.done():
-                    r.future.set_exception(e)
-                    self.metrics.record_failure(r.n_rows)
+                    if settle_future(r.future, exc=e):
+                        self.metrics.record_failure(r.n_rows)
 
     # -- stats / lifecycle -------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        """False once the batcher thread has DIED without an orderly
+        stop — a crashed dispatch (or an injected ``ReplicaDeathFault``)
+        took it down, so accepted work can no longer dispatch.  A parked
+        (``start=False``, not yet started) service counts as alive: it
+        can still be started.  This is the liveness predicate the
+        ``ReplicaSet`` supervisor polls."""
+        return not self._stopped and not self._batcher.dead
+
+    def revive(self) -> bool:
+        """Replace a DEAD batcher thread with a fresh one over the SAME
+        warmed bucket executables — no recompile, params untouched, the
+        service keeps its name/metrics.  The dead batcher's stranded
+        backlog is cancelled first (its futures are typically already
+        failed over by the ``ReplicaSet`` supervisor).  No-op (returns
+        False) while the current batcher is healthy; raises
+        :class:`ServiceClosed` after :meth:`stop`."""
+        if self._stopped:
+            raise ServiceClosed(
+                f"cannot revive stopped service {self.name!r}")
+        if not self._batcher.dead:
+            return False
+        cancelled = self._batcher.close(drain=False, timeout=1.0)
+        if cancelled:
+            self.metrics.record_cancel(cancelled)
+        self._finalizer.detach()
+        self._batcher = self._make_batcher()
+        self._finalizer = weakref.finalize(
+            self, RequestBatcher.close, self._batcher, True, 5.0)
+        self._batcher.start()
+        return True
+
+    @property
+    def last_progress(self) -> Optional[float]:
+        """Monotonic time of the batcher's last completed dispatch (or
+        its start; None before either) — the liveness signal the
+        ``ReplicaSet`` supervisor uses to tell a WEDGED replica from a
+        merely congested one."""
+        return self._batcher.last_progress
+
     def queue_depth(self) -> int:
         return self._batcher.depth()
 

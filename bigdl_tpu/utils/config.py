@@ -76,6 +76,25 @@ class Config:
     serving_max_batch_size: int = 32
     serving_batch_timeout_ms: float = 2.0
     serving_queue_capacity: int = 256
+    # resilience (bigdl_tpu/resilience — designed-in failure handling):
+    # serving_deadline_ms is the default per-request deadline a
+    # ReplicaSet stamps on submissions (0 = none; the deadline travels
+    # with the request — expired work is refused before the device
+    # call, and the supervisor fails work stuck on a dead replica so
+    # the router can retry it elsewhere).  numeric_guard is the
+    # training driver's non-finite loss/grad policy: "off" (default —
+    # provably inert) | "skip" (jnp.where-gate the update on device,
+    # count, continue) | "rollback" (restore the latest VALID
+    # checkpoint, bounded by failure_retry_times) | "abort" (fail
+    # loudly at the exact iteration).  fault_plan names a deterministic
+    # fault-injection plan (grammar in resilience/faults.py; "" = no
+    # injector object even exists — the bitwise-inert state) seeded by
+    # fault_seed, so every degradation path is gated by a test instead
+    # of hand-checked during incidents.
+    serving_deadline_ms: float = 0.0
+    numeric_guard: str = "off"
+    fault_plan: str = ""
+    fault_seed: int = 0
     # custom-kernel selection (bigdl_tpu/ops/pallas_*.py — the fused
     # LSTM cell and COO embedding-bag):  "xla" = always the baseline
     # lowering; "pallas" = fused kernel wherever its measured

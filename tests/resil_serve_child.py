@@ -1,0 +1,125 @@
+"""Subprocess self-healing child for tests/test_resilience.py.
+
+Runs a 4-replica :class:`~bigdl_tpu.resilience.ReplicaSet` under
+multi-threaded closed-loop traffic while a seeded fault plan kills
+replica 0's batcher thread mid-sweep (``replica_death@target=0`` — a
+BaseException escapes the dispatch handler, exactly like a real thread
+crash).  Every request is accounted one-by-one; the parent asserts on
+the JSON this prints:
+
+- ``lost`` must be 0: every accepted request resolved with a result or
+  an explicit error (the join proves no future was stranded);
+- ``wrong`` must be 0: every successful result allclose-equals the
+  precomputed expected output (a failover must never fabricate rows);
+- the death → quarantine → failover → revival → probation →
+  readmission cycle must appear in the ``resilience/*`` counters and
+  the final health states must be all-healthy (re-admitted).
+
+A real subprocess (not a thread in the test runner) so the injected
+BaseException's thread-kill semantics can't poison the pytest process.
+
+Exit codes: 0 = ran to completion (the parent asserts on the JSON),
+1 = crashed (traceback on stderr).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from bigdl_tpu import nn  # noqa: E402
+from bigdl_tpu.resilience import ReplicaSet  # noqa: E402
+from bigdl_tpu.resilience.faults import FaultInjector  # noqa: E402
+from bigdl_tpu.resilience.health import HealthPolicy  # noqa: E402
+from bigdl_tpu.serving import (DeadlineExceeded,  # noqa: E402
+                               ServiceOverloaded)
+
+N_REPLICAS, N_THREADS, DIN = 4, 4, 16
+KILL_AFTER = 5       # replica-0 dispatch index floor for the kill
+RUN_S = 4.0          # long enough for probation + readmission
+PROBE_BACKOFF_S = 0.2
+
+
+def main():
+    model = nn.Sequential(nn.Linear(DIN, 32), nn.ReLU(),
+                          nn.Linear(32, 4), nn.SoftMax()).initialize(0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (1, DIN)).astype(np.float32)
+    rs = ReplicaSet(
+        model, n_replicas=N_REPLICAS,
+        input_spec=((DIN,), np.float32), max_batch_size=8,
+        batch_timeout_ms=1.0, queue_capacity=1024, name="child",
+        deadline_ms=3000.0, max_retries=2,
+        health=HealthPolicy(probe_backoff_s=PROBE_BACKOFF_S, seed=0),
+        fault_injector=FaultInjector(
+            f"replica_death@target=0,after={KILL_AFTER},count=1",
+            seed=0))
+    expected = np.asarray(rs.predict(x, timeout=30))
+
+    counts = {"ok": 0, "wrong": 0, "shed": 0, "deadline": 0, "error": 0}
+    lock = threading.Lock()
+    deadline = time.monotonic() + RUN_S
+
+    def worker():
+        while time.monotonic() < deadline:
+            try:
+                got = rs.predict(x, timeout=2.0)
+            except ServiceOverloaded:
+                with lock:
+                    counts["shed"] += 1
+                time.sleep(0.005)
+                continue
+            except (DeadlineExceeded, TimeoutError):
+                with lock:
+                    counts["deadline"] += 1
+                continue
+            except Exception:
+                with lock:
+                    counts["error"] += 1
+                continue
+            good = np.allclose(np.asarray(got), expected,
+                               rtol=1e-5, atol=1e-7)
+            with lock:
+                counts["ok" if good else "wrong"] += 1
+
+    saw_quarantine = [False]
+
+    def monitor():
+        while time.monotonic() < deadline:
+            if "quarantined" in rs.health_states():
+                saw_quarantine[0] = True
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=worker)
+               for _ in range(N_THREADS)] \
+        + [threading.Thread(target=monitor)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()  # every accepted request resolved — nothing stranded
+
+    stats = rs.stats()
+    final_health = rs.health_states()
+    rs.stop()
+    print(json.dumps({
+        "counts": counts,
+        "lost": 0,  # the joins above prove it: no call still blocked
+        "saw_quarantine": saw_quarantine[0],
+        "final_health": final_health,
+        "resilience": {k: v for k, v in
+                       sorted(stats["resilience"].items()) if v},
+    }))
+
+
+if __name__ == "__main__":
+    main()

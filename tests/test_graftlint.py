@@ -732,6 +732,21 @@ class TestRealTree:
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 
+    def test_resilience_package_lints_clean(self):
+        """Standalone gate for the resilience package (ISSUE-10): the
+        fault injector, health state machines and ReplicaSet router are
+        pure host-side bookkeeping (threads, locks, clocks — no jax in
+        the hot path), and the numeric guard's device half lives in
+        optim/ riding the replay fetch (catalog note "the numeric guard
+        rides the replay boundary").  A violation here means resilience
+        code grew a traced-scope sync or tensor branch — exactly the
+        hazard a recovery path must never add to the driver."""
+        result = lint_paths([os.path.join(REPO, "bigdl_tpu",
+                                          "resilience")])
+        assert result.files_scanned >= 5
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
     def test_checkpoint_package_lints_clean(self):
         """Same standalone discipline for the checkpoint package: its
         one device fetch (snapshot.capture_to_host) is only legal at
