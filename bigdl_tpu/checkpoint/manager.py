@@ -52,7 +52,7 @@ class CheckpointManager:
     def __init__(self, directory: str, keep_last: int = 5,
                  keep_every: int = 0, overwrite: bool = True,
                  async_save: bool = True, registry=None,
-                 queue_depth: int = 2):
+                 queue_depth: int = 2, flight=None):
         self.directory = directory
         self.keep_last = max(1, int(keep_last))
         self.keep_every = max(0, int(keep_every))
@@ -60,6 +60,13 @@ class CheckpointManager:
         self._writer = AsyncSnapshotWriter(queue_depth) if async_save \
             else None
         self._registry = registry
+        # optional telemetry.FlightRecorder + the run's trace context
+        # (the driver stamps trace_id per run): checkpoint COMMITS are
+        # flight events — the event fires on the writer thread after
+        # fsync, so the black box records what actually reached disk,
+        # not what was merely enqueued
+        self.flight = flight
+        self.trace_id: Optional[str] = None
         self._t_run_start: Optional[float] = None
         self._driver_stall_s = 0.0
         # step of the newest save THIS manager issued (None = none yet);
@@ -143,6 +150,10 @@ class CheckpointManager:
                 reg.counter("checkpoint/bytes_written").inc(
                     _tree_bytes(host))
                 reg.counter("checkpoint/snapshots_committed").inc()
+            if self.flight is not None:
+                self.flight.record("checkpoint_commit", cat="driver",
+                                   trace_id=self.trace_id, step=step,
+                                   path=path)
             logger.info("checkpoint saved to %s", path)
 
         if sync or self._writer is None:

@@ -204,6 +204,15 @@ class Optimizer:
         self.telemetry_enabled: Optional[bool] = None
         self.telemetry_trace_path: Optional[str] = None
         self._telemetry: Optional[DriverTelemetry] = None
+        # flight recorder (bigdl_tpu/telemetry/flight): None — the
+        # provably-inert state — unless Config.flight_recorder_path is
+        # set; resolved per run by _train_driver.  Driver events
+        # (checkpoint commits, rollbacks, numeric-guard hits,
+        # preemption, crashes) land there with the run's trace_id.
+        self._flight = None
+        # admin-plane source name, minted once per optimizer (stable
+        # across this optimizer's runs, unique across optimizers)
+        self._admin_name: Optional[str] = None
         self._eval_fwd = None  # cached jit'd eval forward
         self._resume_opt_state = None  # optimizer state restored on retry
         self.compute_dtype = None  # None = full f32; jnp.bfloat16 for MXU
@@ -598,6 +607,17 @@ class Optimizer:
             return NULL_SPAN
         return tel.tracer.span(name, cat=cat, **args)
 
+    def _flight_event(self, event: str, **fields) -> None:
+        """Record one driver event in the flight recorder (no-op when
+        none is live), carrying the run's trace context when telemetry
+        is on — the join key ``tools/obs_report.py`` correlates by."""
+        fl = self._flight
+        if fl is not None:
+            tel = self._telemetry
+            fl.record(event, cat="driver",
+                      trace_id=(tel.trace_id if tel is not None
+                                else None), **fields)
+
     def _checkpoint_manager(self) -> CheckpointManager:
         if self._ckpt_manager is None:
             from bigdl_tpu.utils.config import get_config
@@ -822,18 +842,46 @@ class Optimizer:
         cfg = get_config()
         tel_on = (self.telemetry_enabled if self.telemetry_enabled
                   is not None else cfg.telemetry_enabled)
+        # flight recorder: None (inert) unless Config.flight_recorder_
+        # path is set — every driver event site guards on that
+        from bigdl_tpu.telemetry import flight as _flight_mod
+        self._flight = _flight_mod.from_config()
         tel = None
         if tel_on:
             tel = self._telemetry = DriverTelemetry(
                 registry=self.metrics.registry,
                 trace_capacity=cfg.telemetry_trace_capacity,
                 trace_path=(self.telemetry_trace_path
-                            or cfg.telemetry_trace_path or None))
+                            or cfg.telemetry_trace_path or None),
+                flight=self._flight)
         else:
             # drop any bundle from a previous enabled run on this
             # optimizer — _tel_span/_replay_block read self._telemetry,
             # so a stale one would keep recording through an "off" run
             self._telemetry = None
+        # admin plane: config-driven (admin_port=0 → None, no thread);
+        # the driver registry, tracer, and watchdog verdicts become
+        # scrape-able while the run is live.  The source name is
+        # unique-per-optimizer (stable across this optimizer's runs) so
+        # concurrent drivers don't overwrite each other's registration.
+        from bigdl_tpu.telemetry import admin as _admin
+        _srv = _admin.maybe_start()
+        if _srv is not None:
+            if getattr(self, "_admin_name", None) is None:
+                self._admin_name = _srv.unique_source_name("driver")
+            _srv.add_registry(self._admin_name, self.metrics.registry)
+            if tel is not None:
+                _srv.add_tracer(self._admin_name, tel.tracer)
+                _srv.add_health(self._admin_name, tel.health_snapshot)
+            else:
+                # a telemetry-off rerun on this optimizer must not
+                # leave the PREVIOUS run's tracer/health serving as
+                # current — /healthz would report a dead run's
+                # watchdog verdicts
+                _srv.drop_tracer(self._admin_name)
+                _srv.drop_health(self._admin_name)
+            if self._flight is not None:
+                _srv.set_flight(self._flight)
         # resilience: the numeric-guard policy this run's block fns and
         # replay share, and the fault injector (None — the provably
         # inert state — unless Config.fault_plan is live; every site
@@ -872,6 +920,11 @@ class Optimizer:
         if self.checkpoint_path:
             mgr = self._checkpoint_manager()
             mgr.mark_run_start()
+            # the manager outlives runs (cached) — stamp THIS run's
+            # flight recorder + trace context so its commit events
+            # correlate with this run's trace
+            mgr.flight = self._flight
+            mgr.trace_id = tel.trace_id if tel is not None else None
         epoch_size = self._epoch_size = self.dataset.size()
         data_iter = self.dataset.data(train=True)
         self._fast_forward(data_iter, state)
@@ -965,6 +1018,11 @@ class Optimizer:
                     logger.warning(
                         "preemption signal: final snapshot at iteration "
                         "%d, exiting cleanly", state["neval"])
+                    # flag-only handler fired; the heavy work (and this
+                    # event) runs here on the driver thread — writing
+                    # from a signal handler is how dumps get torn
+                    self._flight_event("preemption",
+                                       iteration=state["neval"])
                     mgr.wait()  # writer idle → no concurrent GC below
                     if mgr.last_saved_step != state["neval"]:
                         # a trigger checkpoint that fired on this very
@@ -1041,6 +1099,15 @@ class Optimizer:
                     pending = block
         finally:
             run_failing = sys.exc_info()[0] is not None
+            if run_failing:
+                # the black box's raison d'être: the crash is on disk
+                # (the recorder flushes per event) even if nothing
+                # below gets to run
+                etype = sys.exc_info()[0]
+                self._flight_event("run_crash",
+                                   error=getattr(etype, "__name__",
+                                                 str(etype)),
+                                   iteration=state["neval"])
             if preempt is not None:
                 preempt.uninstall()
             if tel is not None:
@@ -1081,10 +1148,14 @@ class Optimizer:
                 self._telemetry.tracer.instant(
                     "nonfinite_step_skipped", cat="resilience",
                     step=step)
+            self._flight_event("nonfinite_step", step=step,
+                               policy="skip", loss=float(losses[j]))
             logger.warning(
                 "non-finite step at iteration %d (loss=%s) — update "
                 "skipped on device", step, float(losses[j]))
             return
+        self._flight_event("nonfinite_step", step=step, policy=policy,
+                           loss=float(losses[j]))
         raise NonFiniteStepError(step, float(losses[j]), policy)
 
     def _rollback_nonfinite(self, e: NonFiniteStepError,
@@ -1105,6 +1176,11 @@ class Optimizer:
         if ckpt is None:
             raise e
         self.metrics.registry.counter("resilience/rollbacks").inc()
+        if self._telemetry is not None:
+            self._telemetry.tracer.instant(
+                "rollback", cat="resilience", step=e.step, ckpt=ckpt)
+        self._flight_event("rollback", step=e.step, ckpt=ckpt,
+                           attempt=attempts)
         logger.warning(
             "non-finite step at iteration %d; rollback %d/%d from %s",
             e.step, attempts, retry_budget, ckpt)

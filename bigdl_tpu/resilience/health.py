@@ -71,10 +71,14 @@ class ReplicaHealth:
     injectable so unit tests can drive probation without sleeping."""
 
     def __init__(self, ix: int, policy: Optional[HealthPolicy] = None,
-                 registry=None, clock=time.monotonic):
+                 registry=None, clock=time.monotonic, recorder=None):
         self.ix = ix
         self.policy = policy or HealthPolicy()
         self._registry = registry
+        # optional telemetry.FlightRecorder: every state TRANSITION is
+        # recorded there (events ride boundaries the machine already
+        # crosses — no new work on the no-transition path)
+        self._recorder = recorder
         self._clock = clock
         self._lock = threading.Lock()
         self._state = HEALTHY
@@ -89,8 +93,14 @@ class ReplicaHealth:
         if self._registry is not None:
             self._registry.counter(f"resilience/{name}").inc()
 
+    def _transition(self, frm: str, to: str) -> None:
+        if self._recorder is not None:
+            self._recorder.record("health_transition", cat="resilience",
+                                  replica=self.ix, frm=frm, to=to)
+
     def _quarantine_locked(self, now: float) -> None:
         if self._state != QUARANTINED:
+            self._transition(self._state, QUARANTINED)
             self._state = QUARANTINED
             self._count("quarantines")
         self._schedule_probe_locked(now)
@@ -143,10 +153,12 @@ class ReplicaHealth:
             if self._state == QUARANTINED:
                 if not probe:
                     return  # stale non-probe completion; wait for probe
+                self._transition(QUARANTINED, HEALTHY)
                 self._state = HEALTHY
                 self._backoff_s = self.policy.probe_backoff_s
                 self._count("readmissions")
             elif self._state == DEGRADED:
+                self._transition(DEGRADED, HEALTHY)
                 self._state = HEALTHY
 
     def record_failure(self, probe: bool = False,
@@ -172,6 +184,7 @@ class ReplicaHealth:
                 self._quarantine_locked(now)
             elif self._consecutive_failures >= p.degraded_after:
                 if self._state != DEGRADED:
+                    self._transition(self._state, DEGRADED)
                     self._state = DEGRADED
                     self._count("degradations")
 
@@ -219,8 +232,9 @@ class CircuitBreaker:
     def __init__(self, trip_after: int = 5, cooldown_s: float = 30.0,
                  cooldown_factor: float = 2.0,
                  cooldown_max_s: float = 300.0, registry=None,
-                 name: str = "", clock=time.monotonic):
+                 name: str = "", clock=time.monotonic, recorder=None):
         self.trip_after = max(1, int(trip_after))
+        self._recorder = recorder  # optional telemetry.FlightRecorder
         self._base_cooldown_s = float(cooldown_s)
         self._cooldown_s = float(cooldown_s)
         self._cooldown_factor = float(cooldown_factor)
@@ -257,6 +271,11 @@ class CircuitBreaker:
                 if self._registry is not None:
                     self._registry.counter(
                         "resilience/breaker_trips").inc()
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "breaker_trip", cat="resilience",
+                        version=self._name, trips=self.trips,
+                        cooldown_s=round(self._cooldown_s, 3))
 
     def allow(self, now: Optional[float] = None) -> bool:
         if now is None:

@@ -78,19 +78,22 @@ class _Route:
     """Caller-facing request state: the outer future plus the retry
     budget.  One _Route may span several replica attempts;
     ``last_exc`` remembers the most recent attempt's real failure so
-    running out of replicas surfaces THAT, not a fabricated shed."""
+    running out of replicas surfaces THAT, not a fabricated shed.
+    ``ctx`` (optional RequestContext) accumulates the hop history —
+    one entry per attempt, outcome stamped at completion."""
 
     __slots__ = ("x", "outer", "deadline", "tries_left", "tried",
-                 "last_exc")
+                 "last_exc", "ctx")
 
     def __init__(self, x, outer: Future, deadline: Optional[float],
-                 tries_left: int):
+                 tries_left: int, ctx=None):
         self.x = x
         self.outer = outer
         self.deadline = deadline
         self.tries_left = tries_left
         self.tried: set = set()
         self.last_exc: Optional[BaseException] = None
+        self.ctx = ctx
 
 
 class ReplicaSet:
@@ -111,7 +114,18 @@ class ReplicaSet:
       max_retries).
     - ``health``: a :class:`HealthPolicy` (thresholds/probation
       backoff) shared by all replicas.
-    - ``registry`` / ``tracer``: where resilience events land.
+    - ``registry`` / ``tracer``: where resilience events land.  With
+      ``Config.request_tracing`` on and no tracer given, the set mints
+      its own so request spans/flow edges have somewhere to go.
+    - ``flight``: optional :class:`~bigdl_tpu.telemetry.FlightRecorder`
+      (None = ``telemetry.flight.from_config()``, which is None — the
+      inert state — unless ``Config.flight_recorder_path`` is set).
+      Deaths, quarantines, failovers, sheds, probes and revivals are
+      recorded there with the victim request's trace_id, so a crash
+      dump tells the full story (``tools/obs_report.py``).
+    - ``request_tracing``: mint a :class:`~bigdl_tpu.telemetry.
+      RequestContext` per submit (None = ``Config.request_tracing``);
+      contexts carry the per-request hop history.
     """
 
     _SUPERVISOR_POLL_S = 0.02  # liveness/deadline sweep while inflight
@@ -128,13 +142,26 @@ class ReplicaSet:
                  health: Optional[HealthPolicy] = None,
                  fault_injector: Optional[FaultInjector] = None,
                  registry: Optional[MetricRegistry] = None,
-                 tracer=None, start: bool = True):
+                 tracer=None, start: bool = True, flight=None,
+                 request_tracing: Optional[bool] = None):
         import jax
+
+        from bigdl_tpu.telemetry import admin as _admin
+        from bigdl_tpu.telemetry import flight as _flight_mod
+        from bigdl_tpu.utils.config import get_config
 
         self.name = name
         self.registry = registry if registry is not None \
             else MetricRegistry()
+        if request_tracing is None:
+            request_tracing = get_config().request_tracing
+        self._request_tracing = bool(request_tracing)
+        if tracer is None and self._request_tracing:
+            from bigdl_tpu.telemetry.tracer import Tracer
+            tracer = Tracer(enabled=True)
         self.tracer = tracer
+        self._flight = flight if flight is not None \
+            else _flight_mod.from_config()
         self.max_retries = max(0, int(max_retries))
         if deadline_ms is None:
             # the same explicit > env > tuned[workload] > default chain
@@ -179,11 +206,14 @@ class ReplicaSet:
                 batch_timeout_ms=batch_timeout_ms,
                 queue_capacity=queue_capacity, buckets=buckets,
                 workload=workload, name=f"{name}/r{i}",
-                start=start, fault_injector=self._faults)
+                start=start, fault_injector=self._faults,
+                tracer=self.tracer,
+                request_tracing=self._request_tracing)
             svc._fault_replica = i
             self._replicas.append(svc)
             self._health.append(ReplicaHealth(
-                i, policy=policy, registry=self.registry))
+                i, policy=policy, registry=self.registry,
+                recorder=self._flight))
 
         # counters created eagerly so a zero-event run still snapshots
         # the full schema
@@ -191,6 +221,23 @@ class ReplicaSet:
                   "readmissions", "probes", "degradations",
                   "deadline_timeouts", "replica_deaths", "revivals"):
             self.registry.counter(f"resilience/{c}")
+
+        # admin plane: config-driven start + source registration — the
+        # set-level resilience counters, every replica's serving
+        # registry, the tracer, and a health provider all scrape from
+        # one endpoint (admin_port=0 → None: nothing runs).  The name
+        # is minted unique so two same-named sets don't evict each
+        # other; replicas minted their own unique names above.
+        self._admin_name: Optional[str] = None
+        _srv = _admin.maybe_start()
+        if _srv is not None:
+            self._admin_name = _srv.unique_source_name(self.name)
+            _srv.add_registry(self._admin_name, self.registry)
+            _srv.add_health(self._admin_name, self.health_snapshot)
+            if self.tracer is not None:
+                _srv.add_tracer(self._admin_name, self.tracer)
+            if self._flight is not None:
+                _srv.set_flight(self._flight)
 
         self._lock = threading.Lock()
         # one death handler may run per replica at a time: routing and
@@ -208,6 +255,12 @@ class ReplicaSet:
     def _instant(self, event: str, **args) -> None:
         if self.tracer is not None:
             self.tracer.instant(event, cat="resilience", **args)
+
+    def _flight_event(self, event: str, trace_id=None, **fields) -> None:
+        if self._flight is not None:
+            self._flight.record(event, cat="resilience",
+                                trace_id=trace_id, model=self.name,
+                                **fields)
 
     # ----------------------------------------------------------- routing
     def _pick(self, route: _Route):
@@ -251,6 +304,9 @@ class ReplicaSet:
         probation window when health is."""
         self.registry.counter("resilience/sheds").inc()
         self._instant("shed", model=self.name)
+        self._flight_event("shed", trace_id=(route.ctx.trace_id
+                                             if route.ctx is not None
+                                             else None))
         if last_overload is not None:
             retry_ms = last_overload.retry_after_ms
             depth, cap = last_overload.queue_depth, last_overload.capacity
@@ -288,7 +344,8 @@ class ReplicaSet:
             ix, probe = picked
             svc = self._replicas[ix]
             try:
-                inner = svc.submit(route.x, deadline=route.deadline)
+                inner = svc.submit(route.x, deadline=route.deadline,
+                                   ctx=route.ctx)
             except ServiceOverloaded as e:
                 last_overload = e
                 if probe:
@@ -313,6 +370,23 @@ class ReplicaSet:
                     raise
                 _settle(route.outer, exc=e)
                 return
+            if route.ctx is not None:
+                # the request's hop history: one entry per accepted
+                # attempt, outcome stamped in _on_done — a failed-over
+                # request reads "r0: ReplicaDeadError → r2: ok".  The
+                # flight recorder only sees the RARE path: retry
+                # landings (attempt > 1).  First attempts are routine
+                # traffic — recording them would put a locked
+                # write+flush on every request and evict the rare
+                # death/quarantine events from the bounded ring; the
+                # original dispatch's replica still reaches the dump
+                # on the failover event's hops field.
+                route.ctx.add_hop(ix, probe=probe)
+                if len(route.ctx.hops) > 1:
+                    self._flight_event("request_route",
+                                       trace_id=route.ctx.trace_id,
+                                       replica=ix, probe=probe,
+                                       attempt=len(route.ctx.hops))
             token = next(self._token)
             with self._lock:
                 self._inflight[token] = (route, ix, inner, probe)
@@ -335,10 +409,16 @@ class ReplicaSet:
                 f"replica {ix} cancelled the request")
         else:
             exc = inner.exception()
+        if route.ctx is not None and route.ctx.hops:
+            # hops are appended one at a time and at most one attempt
+            # of a route is in flight, so the last hop is this one
+            route.ctx.hops[-1]["outcome"] = (
+                "ok" if exc is None else type(exc).__name__)
         if exc is None:
             health.record_success(probe=probe)
             if probe:
                 self._instant("readmission_probe_ok", replica=ix)
+                self._flight_event("readmission_probe_ok", replica=ix)
             _settle(route.outer, result=inner.result())
             return
         # failure: classify, record, maybe fail over
@@ -370,8 +450,20 @@ class ReplicaSet:
             route.tried.add(ix)
             route.last_exc = exc  # surfaced if no replica is left
             self.registry.counter("resilience/failovers").inc()
+            trace_id = route.ctx.trace_id if route.ctx is not None \
+                else None
             self._instant("failover", replica=ix,
-                          error=type(exc).__name__)
+                          error=type(exc).__name__,
+                          **({"trace_id": trace_id} if trace_id else {}))
+            # the hop history rides the failover event, so the dump
+            # shows the ORIGINAL dispatch replica without a per-request
+            # route event (see _attempt)
+            hops = ([f"r{h['replica']}:{h['outcome']}"
+                     for h in route.ctx.hops]
+                    if route.ctx is not None else None)
+            self._flight_event("failover", trace_id=trace_id,
+                               replica=ix, error=type(exc).__name__,
+                               **({"hops": hops} if hops else {}))
             self._attempt(route)
             return
         _settle(route.outer, exc=exc)
@@ -435,37 +527,78 @@ class ReplicaSet:
                 self._wake.wait(timeout=self._SUPERVISOR_POLL_S)
 
     def _on_replica_dead(self, ix: int) -> None:
-        """Quarantine + revive a replica whose batcher thread died.
-        Idempotent per death: revive() is a no-op on a running batcher."""
+        """Quarantine + revive a replica whose batcher thread died, and
+        fail over the requests stranded ON it.  Idempotent per death:
+        revive() is a no-op on a running batcher.
+
+        The stranded sweep here is load-bearing, not an optimization:
+        a request mid-dispatch at the moment of death is already marked
+        RUNNING, so revive's backlog cancellation cannot touch it, and
+        the supervisor's liveness poll only catches it while the
+        replica still reads as dead — if THIS handler revives first
+        (routing-path detection racing the ~20 ms poll), ``svc.alive``
+        flips back to True and the supervisor never sees the death,
+        stranding the request until its deadline (forever, with none).
+        Collecting the victims inside the death lock is exact: the
+        replica is quarantined before revive, so no new request can be
+        routed to it until its probation window opens."""
         svc = self._replicas[ix]
+        stranded: list = []
         with self._death_locks[ix]:
             if svc.alive or self._stopped:
                 return  # someone else already revived it (or shutdown)
             self.registry.counter("resilience/replica_deaths").inc()
             self._health[ix].mark_dead()
             self._instant("replica_death", replica=ix)
+            self._flight_event("replica_death", replica=ix)
             logger.warning("replica %d of %r died; quarantined, "
                            "reviving", ix, self.name)
+            with self._lock:
+                stranded = [(route, inner) for (route, ix2, inner, _p)
+                            in self._inflight.values() if ix2 == ix]
             try:
                 svc.revive()
                 self.registry.counter("resilience/revivals").inc()
+                self._flight_event("revival", replica=ix)
             except Exception:
                 logger.exception("replica %d revive failed; it stays "
                                  "quarantined until the next probe", ix)
+        # settle OUTSIDE the death lock: each settle runs _on_done →
+        # failover → _pick on this thread, which may legally re-enter
+        # this handler for another replica
+        for route, inner in stranded:
+            if not inner.done():
+                if _settle(inner, exc=ReplicaDeadError(
+                        f"replica {ix} of {self.name!r} died with this "
+                        f"request in flight")):
+                    trace_id = (route.ctx.trace_id
+                                if route.ctx is not None else None)
+                    self._flight_event("stranded_failover",
+                                       trace_id=trace_id, replica=ix)
 
     # --------------------------------------------------------------- api
-    def submit(self, x, *, timeout: Optional[float] = None) -> Future:
+    def submit(self, x, *, timeout: Optional[float] = None,
+               ctx=None) -> Future:
         """Route one request (≤ max_batch_size rows).  Returns a Future
         that ALWAYS resolves: result, explicit error, or
         ``ServiceOverloaded``/``DeadlineExceeded``.  ``timeout`` (or the
         set-level ``deadline_ms``) bounds the whole request including
-        failovers."""
+        failovers.
+
+        ``ctx``: optional :class:`~bigdl_tpu.telemetry.RequestContext`
+        (minted here when ``request_tracing`` is on) — it accumulates
+        the request's hop history across failovers; a caller that keeps
+        a reference reads the full routing story after the future
+        resolves."""
         if self._stopped:
             raise ServiceClosed(f"replica set {self.name!r} is stopped")
         deadline_s = (timeout if timeout is not None else self.deadline_s)
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
-        route = _Route(x, Future(), deadline, self.max_retries)
+        if ctx is None and self._request_tracing:
+            from bigdl_tpu.telemetry.context import RequestContext
+            ctx = RequestContext(deadline=deadline)
+        route = _Route(x, Future(), deadline, self.max_retries, ctx=ctx)
         self._attempt(route, initial=True)
         return route.outer
 
@@ -502,13 +635,33 @@ class ReplicaSet:
     def health_states(self) -> List[str]:
         return [h.state for h in self._health]
 
+    def health_snapshot(self) -> dict:
+        """The ``/healthz`` provider: per-replica liveness + health
+        states, ``ok`` iff every replica is alive and un-quarantined."""
+        states = self.health_states()
+        alive = [svc.alive for svc in self._replicas]
+        return {
+            "ok": all(alive) and QUARANTINED not in states,
+            "model": self.name,
+            "replicas": [
+                {"ix": i, "alive": alive[i], "state": states[i],
+                 "queue_depth": self._replicas[i].queue_depth()}
+                for i in range(len(self._replicas))],
+        }
+
     def start(self) -> None:
         for svc in self._replicas:
             svc.start()
 
     def stats(self) -> dict:
-        """Set-level snapshot: per-replica service stats + health, plus
-        the resilience counters."""
+        """Set-level snapshot: per-replica service stats + health, the
+        resilience counters, and the ``aggregate`` view — summed
+        counters, set-level throughput over the UNION of the replicas'
+        activity windows, and latency percentiles over the
+        concatenated reservoir windows (``ServingMetrics.aggregate``;
+        the window-bias audit — NOT replica 0's numbers and NOT a sum
+        of per-replica rates with mismatched denominators)."""
+        from bigdl_tpu.serving.metrics import ServingMetrics
         return {
             "model": self.name,
             "replicas": [
@@ -516,6 +669,10 @@ class ReplicaSet:
                  "health": self._health[i].snapshot(),
                  **svc.stats()}
                 for i, svc in enumerate(self._replicas)],
+            "aggregate": ServingMetrics.aggregate(
+                [svc.metrics for svc in self._replicas],
+                queue_depth=sum(s.queue_depth()
+                                for s in self._replicas)),
             "resilience": self.registry.snapshot()["counters"],
         }
 
@@ -530,6 +687,13 @@ class ReplicaSet:
             svc.stop(drain=drain, timeout=timeout)
         if self._supervisor is not None:
             self._supervisor.join(timeout=2.0)
+        # deregister from the admin plane: a retired set left behind
+        # would report its parked replicas as a permanent /healthz 503
+        if self._admin_name is not None:
+            from bigdl_tpu.telemetry import admin as _admin
+            _srv = _admin.current()
+            if _srv is not None:
+                _srv.remove_source(self._admin_name)
 
     def __enter__(self) -> "ReplicaSet":
         return self

@@ -95,16 +95,21 @@ class _Request:
     shared leading row dim ``n_rows`` (≤ max_batch_size, enforced by the
     service) plus the future the caller is waiting on.  ``deadline``
     (monotonic seconds, or None) travels WITH the request through the
-    queue — the dispatch path refuses expired work."""
+    queue — the dispatch path refuses expired work.  ``ctx`` is the
+    optional :class:`~bigdl_tpu.telemetry.context.RequestContext`
+    (trace_id / tenant / hop history) riding the same journey — None
+    (the default) is the provably-inert state."""
 
-    __slots__ = ("x", "n_rows", "future", "t_enqueue", "deadline")
+    __slots__ = ("x", "n_rows", "future", "t_enqueue", "deadline", "ctx")
 
-    def __init__(self, x, n_rows: int, deadline: Optional[float] = None):
+    def __init__(self, x, n_rows: int, deadline: Optional[float] = None,
+                 ctx=None):
         self.x = x
         self.n_rows = n_rows
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
         self.deadline = deadline
+        self.ctx = ctx
 
 
 class RequestBatcher:

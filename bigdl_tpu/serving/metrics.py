@@ -10,15 +10,31 @@ ship it to whatever metrics sink they run.
 Since the telemetry PR the backing store is the unified
 :class:`bigdl_tpu.telemetry.registry.MetricRegistry` (counters +
 reservoir histograms) — the same substrate the training driver and the
-runtime watchdogs use.  ``LatencyReservoir`` is the registry
-:class:`~bigdl_tpu.telemetry.registry.Reservoir` (kept under its
-historical name for back-compat).
+runtime watchdogs use.  Since the admin-plane PR the latency windows are
+registry **histograms** (``serving/latency_s`` global,
+``serving/latency_s_bucket{N}`` per row bucket), so a ``/metrics``
+scrape renders their quantiles with zero extra bookkeeping;
+``LatencyReservoir`` is still the registry
+:class:`~bigdl_tpu.telemetry.registry.Reservoir` and the historical
+``.latency`` attribute is the global histogram's backing reservoir —
+the pre-registry surface keeps working.
 
 Latency reservoirs are keyed TWO ways: one global window (the historical
 surface) and one per row-bucket — a 1-row dispatch and a 32-row-bucket
 dispatch have very different service times, and the global p99 hides
 which bucket is paying it (ROADMAP serving item 1c).  Bucket reservoirs
 appear lazily as traffic exercises each bucket.
+
+Window-bias audit (the admin-plane PR): ``throughput_rps`` used to be
+``completed / uptime`` — a service snapshot taken after traffic stopped
+(or a ReplicaSet replica that idled while its siblings served) diluted
+the rate with idle time.  It is now computed over the ACTIVITY window
+(first submit → last completion); ``throughput_window_s`` reports the
+window so readers can tell a 1 s burst from a 10 s steady state, and
+:meth:`ServingMetrics.aggregate` computes the set-level view over the
+union of the replicas' activity windows instead of summing per-replica
+rates with mismatched denominators (regression-gated in
+``tests/test_obs_plane.py``).
 
 Everything is host-side bookkeeping — nothing here touches jax.
 """
@@ -27,9 +43,10 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
 
-from bigdl_tpu.telemetry.registry import MetricRegistry, Reservoir
+from bigdl_tpu.telemetry.registry import (Histogram, MetricRegistry,
+                                          Reservoir)
 
 # back-compat alias: the serving latency window IS the registry reservoir
 LatencyReservoir = Reservoir
@@ -56,9 +73,19 @@ class ServingMetrics:
         self._dispatches = reg.counter("serving/dispatches")
         self._rows_real = reg.counter("serving/rows_real")
         self._rows_dispatched = reg.counter("serving/rows_dispatched")
-        self.latency = LatencyReservoir()
-        # per-row-bucket latency windows, created as buckets see traffic
-        self._bucket_latency: Dict[int, Reservoir] = {}
+        # global latency window: a registry histogram so /metrics
+        # renders its quantiles; .latency is its backing reservoir (the
+        # historical attribute surface)
+        self._latency_h = reg.histogram("serving/latency_s")
+        self.latency = self._latency_h.reservoir
+        # per-row-bucket latency histograms, created as buckets see
+        # traffic (registry get-or-create is atomic; the lock only
+        # guards the local cache dict)
+        self._bucket_latency: Dict[int, Histogram] = {}
+        # activity window (monotonic): first submit → last completion —
+        # the unbiased throughput denominator (module docstring)
+        self._t_first_submit: Optional[float] = None
+        self._t_last_done: Optional[float] = None
 
     # back-compat value surface (pre-registry these were plain ints)
     @property
@@ -95,6 +122,10 @@ class ServingMetrics:
 
     # -- recording (called from submit / batcher threads) -----------------
     def record_submit(self, rows: int) -> None:
+        if self._t_first_submit is None:
+            # racy-by-design single write: two first submits land
+            # within microseconds of each other — either anchors fine
+            self._t_first_submit = time.monotonic()
         self._submitted.inc(rows)
 
     def record_reject(self, rows: int = 1) -> None:
@@ -108,20 +139,31 @@ class ServingMetrics:
     def record_done(self, rows: int, latency_s: float,
                     bucket: Optional[int] = None) -> None:
         self._completed.inc(rows)
-        self.latency.record(latency_s)
+        self._t_last_done = time.monotonic()
+        self._latency_h.observe(latency_s)
         if bucket is not None:
-            res = self._bucket_latency.get(bucket)
-            if res is None:
+            h = self._bucket_latency.get(bucket)
+            if h is None:
                 with self._lock:  # lazy get-or-create, race-safe
-                    res = self._bucket_latency.setdefault(
-                        bucket, LatencyReservoir())
-            res.record(latency_s)
+                    h = self._bucket_latency.setdefault(
+                        bucket, self.registry.histogram(
+                            f"serving/latency_s_bucket{bucket}"))
+            h.observe(latency_s)
 
     def record_failure(self, rows: int) -> None:
         self._failed.inc(rows)
 
     def record_cancel(self, rows: int) -> None:
         self._cancelled.inc(rows)
+
+    # -- windows -----------------------------------------------------------
+    def activity_window(self) -> Optional[tuple]:
+        """(first_submit, last_done) monotonic pair, or None before any
+        completion — the unbiased throughput denominator."""
+        t0, t1 = self._t_first_submit, self._t_last_done
+        if t0 is None or t1 is None:
+            return None
+        return (t0, max(t1, t0))
 
     # -- snapshot ----------------------------------------------------------
     @staticmethod
@@ -134,13 +176,16 @@ class ServingMetrics:
                  compile_count: int = 0) -> dict:
         """Plain-dict stats (the ``service.stats()`` schema documented in
         the README serving section).  Latencies are reported in ms."""
-        elapsed = max(time.monotonic() - self.started_at, 1e-9)
+        uptime = max(time.monotonic() - self.started_at, 1e-9)
+        window = self.activity_window()
+        window_s = max(window[1] - window[0], 1e-9) if window else None
+        completed = self.completed
         rows_dispatched = self.rows_dispatched
         occ = (self.rows_real / rows_dispatched
                if rows_dispatched else None)
         snap = {
             "requests_submitted": self.submitted,
-            "requests_completed": self.completed,
+            "requests_completed": completed,
             "requests_rejected": self.rejected,
             "requests_failed": self.failed,
             "requests_cancelled": self.cancelled,
@@ -148,15 +193,100 @@ class ServingMetrics:
             "rows_dispatched": rows_dispatched,
             "mean_batch_occupancy":
                 round(occ, 4) if occ is not None else None,
-            "throughput_rps": round(self.completed / elapsed, 2),
+            # rate over the ACTIVITY window, not uptime (window-bias
+            # audit in the module docstring); 0.0 before any completion
+            "throughput_rps": (round(completed / window_s, 2)
+                               if window_s is not None else 0.0),
+            "throughput_window_s": (round(window_s, 3)
+                                    if window_s is not None else None),
             "queue_depth": queue_depth,
             "compile_count": compile_count,
-            "uptime_s": round(elapsed, 3),
+            "uptime_s": round(uptime, 3),
         }
-        snap["latency_ms"] = self._ms(self.latency.percentiles())
+        snap["latency_ms"] = self._ms(self._latency_h.percentiles())
         with self._lock:
             buckets = sorted(self._bucket_latency.items())
         snap["latency_ms_by_bucket"] = (
-            {b: self._ms(r.percentiles()) for b, r in buckets}
+            {b: self._ms(h.percentiles()) for b, h in buckets}
             if buckets else None)
         return snap
+
+    # -- set-level aggregation --------------------------------------------
+    @staticmethod
+    def aggregate(metrics: Sequence["ServingMetrics"],
+                  queue_depth: int = 0) -> dict:
+        """Snapshot-shaped aggregate over N per-replica metrics (the
+        ``ReplicaSet.stats()["aggregate"]`` view — satellite audit):
+
+        - counters sum;
+        - ``throughput_rps`` = total completions over the UNION of the
+          replicas' activity windows (earliest first-submit → latest
+          completion) — not a sum of per-replica rates, whose
+          denominators differ, and not replica 0's number;
+        - latency percentiles are computed over the CONCATENATED
+          reservoir windows (global and per bucket), so the set p99 is
+          the p99 of actual recent samples, not an average of averages.
+        """
+        metrics = list(metrics)  # tolerate one-shot iterables
+        tot = {k: 0 for k in
+               ("requests_submitted", "requests_completed",
+                "requests_rejected", "requests_failed",
+                "requests_cancelled", "dispatch_count",
+                "rows_real", "rows_dispatched")}
+        windows: List[tuple] = []
+        lat_samples: List[float] = []
+        bucket_samples: Dict[int, List[float]] = {}
+        for m in metrics:
+            tot["requests_submitted"] += m.submitted
+            tot["requests_completed"] += m.completed
+            tot["requests_rejected"] += m.rejected
+            tot["requests_failed"] += m.failed
+            tot["requests_cancelled"] += m.cancelled
+            tot["dispatch_count"] += m.dispatches
+            tot["rows_real"] += m.rows_real
+            tot["rows_dispatched"] += m.rows_dispatched
+            w = m.activity_window()
+            if w is not None:
+                windows.append(w)
+            lat_samples.extend(m.latency.window())
+            with m._lock:
+                items = list(m._bucket_latency.items())
+            for b, h in items:
+                bucket_samples.setdefault(b, []).extend(
+                    h.reservoir.window())
+        window_s = (max(w[1] for w in windows)
+                    - min(w[0] for w in windows)) if windows else None
+        if window_s is not None:
+            window_s = max(window_s, 1e-9)
+        occ = (tot["rows_real"] / tot["rows_dispatched"]
+               if tot["rows_dispatched"] else None)
+
+        def pct(samples: List[float]) -> Optional[dict]:
+            # same nearest-rank rule as Reservoir.percentiles, computed
+            # directly over the already-materialized sample list
+            n = len(samples)
+            window = sorted(samples)
+            out_ = {}
+            for q in (50, 95, 99):
+                idx = min(n - 1, max(0, int(round(q / 100.0 * n)) - 1))
+                out_[f"p{q}"] = window[idx]
+            out_["mean"] = sum(window) / n
+            out_["max"] = window[-1]
+            return ServingMetrics._ms(out_)
+
+        out = dict(tot)
+        out.pop("rows_real")
+        out["n_sources"] = len(metrics)
+        out["mean_batch_occupancy"] = (round(occ, 4)
+                                       if occ is not None else None)
+        out["throughput_rps"] = (
+            round(tot["requests_completed"] / window_s, 2)
+            if window_s is not None else 0.0)
+        out["throughput_window_s"] = (round(window_s, 3)
+                                      if window_s is not None else None)
+        out["queue_depth"] = queue_depth
+        out["latency_ms"] = pct(lat_samples) if lat_samples else None
+        out["latency_ms_by_bucket"] = (
+            {b: pct(s) for b, s in sorted(bucket_samples.items())}
+            if bucket_samples else None)
+        return out

@@ -83,7 +83,8 @@ class ModelRegistry:
     """
 
     def __init__(self, *, breaker_trip_after: int = 5,
-                 breaker_cooldown_s: float = 30.0, registry=None):
+                 breaker_cooldown_s: float = 30.0, registry=None,
+                 flight=None):
         self._lock = threading.Lock()
         self._services: Dict[Tuple[str, int], InferenceService] = {}
         self._latest: Dict[str, int] = {}
@@ -96,6 +97,23 @@ class ModelRegistry:
         self._breaker_cooldown_s = float(breaker_cooldown_s)
         self._metrics = registry
         self._breakers: Dict[Tuple[str, int], CircuitBreaker] = {}
+        # flight recorder (telemetry round 2): breaker trips and
+        # latest-wins fallbacks land there so a post-mortem sees WHICH
+        # deploy was poisoned and when routing moved off it.  None —
+        # the inert state — unless Config.flight_recorder_path is set
+        # or a recorder is passed explicitly.
+        from bigdl_tpu.telemetry import flight as _flight_mod
+        self._flight = flight if flight is not None \
+            else _flight_mod.from_config()
+        # admin plane: breaker states as a /healthz source (ok = no
+        # breaker currently open).  The name is made unique so two
+        # registries in one process don't overwrite each other.
+        from bigdl_tpu.telemetry import admin as _admin
+        self._admin_name: Optional[str] = None
+        _srv = _admin.maybe_start()
+        if _srv is not None:
+            self._admin_name = _srv.unique_source_name("model_registry")
+            _srv.add_health(self._admin_name, self.breaker_health)
 
     # -- deployment --------------------------------------------------------
     def deploy(self, name: str, model=None, *, path: Optional[str] = None,
@@ -148,7 +166,8 @@ class ModelRegistry:
             self._breakers[key] = CircuitBreaker(
                 trip_after=self._breaker_trip_after,
                 cooldown_s=self._breaker_cooldown_s,
-                registry=self._metrics, name=f"{name}:v{version}")
+                registry=self._metrics, name=f"{name}:v{version}",
+                recorder=self._flight)
             self._latest[name] = max(self._latest.get(name, 0),
                                      int(version))
         return service
@@ -181,6 +200,11 @@ class ModelRegistry:
                 if self._metrics is not None:
                     self._metrics.counter(
                         "resilience/breaker_fallbacks").inc()
+                if self._flight is not None:
+                    self._flight.record(
+                        "breaker_fallback", cat="resilience",
+                        model=name, from_version=newest,
+                        to_version=version)
                 logger.warning(
                     "model %r v%d breaker open — routing to v%d",
                     name, newest, version)
@@ -241,6 +265,16 @@ class ModelRegistry:
         with self._lock:
             return self._breakers[(name, int(version))].snapshot()
 
+    def breaker_health(self) -> dict:
+        """The ``/healthz`` provider: every deployed version's breaker
+        snapshot; ``ok`` = no breaker currently open."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        snaps = {f"{n}:v{v}": brk.snapshot()
+                 for (n, v), brk in sorted(breakers.items())}
+        return {"ok": not any(s["open"] for s in snaps.values()),
+                "breakers": snaps}
+
     def list_models(self) -> Dict[str, List[int]]:
         with self._lock:
             out: Dict[str, List[int]] = {}
@@ -290,6 +324,11 @@ class ModelRegistry:
             self._latest.clear()
         for svc in services:
             svc.stop(drain=drain)
+        if self._admin_name is not None:
+            from bigdl_tpu.telemetry import admin as _admin
+            _srv = _admin.current()
+            if _srv is not None:
+                _srv.remove_source(self._admin_name)
 
     def __enter__(self) -> "ModelRegistry":
         return self

@@ -160,6 +160,15 @@ class InferenceService:
         own dispatch counter) — the chaos hook the resilience tests and
         ``bench.py --resilience`` drive.  ``None`` (the default) is the
         provably-inert state: the dispatch path never touches it.
+    tracer / request_tracing:
+        Request-scoped observability (telemetry round 2).  ``tracer``
+        is an optional :class:`~bigdl_tpu.telemetry.Tracer` — submit
+        and dispatch land as spans, with Chrome flow events fanning
+        the N coalesced request spans into their one dispatch span.
+        ``request_tracing`` (None = ``Config.request_tracing``) mints a
+        :class:`~bigdl_tpu.telemetry.RequestContext` per submit when no
+        explicit context is passed; off (the default), no context is
+        ever allocated and the request path is byte-identical.
     """
 
     def __init__(self, model, params=None, state=None, *,
@@ -168,7 +177,8 @@ class InferenceService:
                  queue_capacity: Optional[int] = None,
                  buckets=None, workload: Optional[str] = None,
                  name: str = "model", start: bool = True,
-                 fault_injector=None):
+                 fault_injector=None, tracer=None,
+                 request_tracing: Optional[bool] = None):
         from bigdl_tpu.engine import Engine
         self.workload = workload
         defaults = Engine.serving_defaults(workload)
@@ -222,6 +232,13 @@ class InferenceService:
         self._out_spec = None
         self._out_row_shape: Optional[Tuple[int, ...]] = None
         self._warm_lock = threading.Lock()
+        # serializes batcher replacement vs shutdown: revive() (on a
+        # supervisor/failover thread) swaps in a new batcher and
+        # start()s it; a concurrent stop() must never observe the new
+        # thread object between creation and start() completing — a
+        # join() there raises "cannot join thread before it is
+        # started" (race surfaced by the obs-plane failover tests)
+        self._lifecycle_lock = threading.Lock()
         self._stopped = False
         self.metrics = ServingMetrics()
         # fault injection (resilience layer): the injector is consulted
@@ -230,6 +247,27 @@ class InferenceService:
         self._faults = fault_injector
         self._fault_replica: Optional[int] = None
         self._dispatch_index = 0
+        # request-scoped observability (telemetry round 2): resolved
+        # ONCE here — the submit/dispatch hot paths only test the
+        # resulting attributes, never read config
+        self.tracer = tracer
+        if request_tracing is None:
+            from bigdl_tpu.utils.config import get_config
+            request_tracing = get_config().request_tracing
+        self._request_tracing = bool(request_tracing)
+        # admin plane: config-driven start (admin_port=0 → None, no
+        # thread) and source registration.  The scrape name is minted
+        # unique (two same-named services must not evict each other,
+        # and THIS service's stop() must only deregister a name it
+        # owns); a retired name is released for the next deploy.
+        from bigdl_tpu.telemetry import admin as _admin
+        self._admin_name: Optional[str] = None
+        _srv = _admin.maybe_start()
+        if _srv is not None:
+            self._admin_name = _srv.unique_source_name(self.name)
+            _srv.add_registry(self._admin_name, self.metrics.registry)
+            if self.tracer is not None:
+                _srv.add_tracer(self._admin_name, self.tracer)
         self._batcher = self._make_batcher()
         self._finalizer = weakref.finalize(
             self, RequestBatcher.close, self._batcher, True, 5.0)
@@ -389,7 +427,8 @@ class InferenceService:
                      for leaf, s in zip(req_leaves, spec_leaves)]
         return _tree.tree_unflatten(req_def, conformed)
 
-    def submit(self, x, *, deadline: Optional[float] = None) -> Future:
+    def submit(self, x, *, deadline: Optional[float] = None,
+               ctx=None) -> Future:
         """Enqueue one request (pytree of arrays, shared leading batch
         dim ``n`` with ``1 <= n <= max_batch_size``) and return the
         Future of its stacked outputs.  Raises
@@ -400,7 +439,13 @@ class InferenceService:
         travels WITH the request through the queue: the dispatch path
         refuses expired work with :class:`DeadlineExceeded` instead of
         burning device time on a caller that has given up — the
-        per-request deadline propagation ``ReplicaSet`` routes on."""
+        per-request deadline propagation ``ReplicaSet`` routes on.
+
+        ``ctx`` is an optional :class:`~bigdl_tpu.telemetry.
+        RequestContext`; with ``request_tracing`` on and ``ctx=None``
+        one is minted here.  It rides the queue with the request — the
+        dispatch span flow-links back to this submit's span, and a
+        router appends its hop history."""
         xs, n = self._normalize_input(x)
         if n == 0:
             f: Future = Future()
@@ -424,14 +469,30 @@ class InferenceService:
             self.warmup(_tree.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), xs))
         xs = self._conform_request(xs)
-        req = _Request(xs, n, deadline=deadline)
+        if ctx is None and self._request_tracing:
+            from bigdl_tpu.telemetry.context import RequestContext
+            ctx = RequestContext(deadline=deadline)
+        req = _Request(xs, n, deadline=deadline, ctx=ctx)
+        tracer = self.tracer
+        if ctx is not None and tracer is not None and tracer.enabled:
+            # the request's submit span, with the outbound half of the
+            # fan-in flow arrow the dispatch span will close
+            with tracer.span("request_submit", cat="serving",
+                             trace_id=ctx.trace_id, model=self.name,
+                             rows=n, tenant=ctx.tenant):
+                tracer.flow_start("req", ctx.flow_id, cat="serving")
+                self._put_counted(req, n)
+        else:
+            self._put_counted(req, n)
+        return req.future
+
+    def _put_counted(self, req: _Request, n: int) -> None:
         try:
             self._batcher.put(req)
         except ServiceOverloaded:
             self.metrics.record_reject(n)
             raise
         self.metrics.record_submit(n)
-        return req.future
 
     def predict(self, x, timeout: Optional[float] = None):
         """Blocking sugar over :meth:`submit`; chunks inputs larger than
@@ -523,6 +584,24 @@ class InferenceService:
             if not live:
                 return
         rows = sum(r.n_rows for r in live)
+        tracer = self.tracer
+        ctxs = ([r.ctx for r in live if r.ctx is not None]
+                if tracer is not None and tracer.enabled else [])
+        if ctxs:
+            # one dispatch span fanning in the N coalesced request
+            # spans: each context's flow arrow (opened in its submit
+            # span) is closed HERE, so Perfetto draws N arrows into
+            # this slice; trace ids ride the span args for grepping
+            with tracer.span("dispatch", cat="serving", model=self.name,
+                             n_requests=len(live), rows=rows,
+                             trace_ids=[c.trace_id for c in ctxs]):
+                for c in ctxs:
+                    tracer.flow_end("req", c.flow_id, cat="serving")
+                self._dispatch_compiled(live, rows)
+        else:
+            self._dispatch_compiled(live, rows)
+
+    def _dispatch_compiled(self, live: List[_Request], rows: int) -> None:
         try:
             if self._faults is not None:
                 # fault site — inside the handler, so an injected
@@ -594,20 +673,21 @@ class InferenceService:
         failed over by the ``ReplicaSet`` supervisor).  No-op (returns
         False) while the current batcher is healthy; raises
         :class:`ServiceClosed` after :meth:`stop`."""
-        if self._stopped:
-            raise ServiceClosed(
-                f"cannot revive stopped service {self.name!r}")
-        if not self._batcher.dead:
-            return False
-        cancelled = self._batcher.close(drain=False, timeout=1.0)
-        if cancelled:
-            self.metrics.record_cancel(cancelled)
-        self._finalizer.detach()
-        self._batcher = self._make_batcher()
-        self._finalizer = weakref.finalize(
-            self, RequestBatcher.close, self._batcher, True, 5.0)
-        self._batcher.start()
-        return True
+        with self._lifecycle_lock:
+            if self._stopped:
+                raise ServiceClosed(
+                    f"cannot revive stopped service {self.name!r}")
+            if not self._batcher.dead:
+                return False
+            cancelled = self._batcher.close(drain=False, timeout=1.0)
+            if cancelled:
+                self.metrics.record_cancel(cancelled)
+            self._finalizer.detach()
+            self._batcher = self._make_batcher()
+            self._finalizer = weakref.finalize(
+                self, RequestBatcher.close, self._batcher, True, 5.0)
+            self._batcher.start()
+            return True
 
     @property
     def last_progress(self) -> Optional[float]:
@@ -630,19 +710,30 @@ class InferenceService:
         return snap
 
     def start(self) -> None:
-        self._batcher.start()
+        with self._lifecycle_lock:
+            self._batcher.start()
 
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None:
         """Graceful shutdown: refuse new submits, drain (default) or
         cancel the backlog, join the batcher.  Idempotent."""
-        if self._stopped:
-            return
-        self._stopped = True
-        self._finalizer.detach()
-        cancelled_rows = self._batcher.close(drain=drain, timeout=timeout)
+        with self._lifecycle_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._finalizer.detach()
+            cancelled_rows = self._batcher.close(drain=drain,
+                                                 timeout=timeout)
         if cancelled_rows:
             self.metrics.record_cancel(cancelled_rows)
+        # a stopped service must not linger on the admin plane (its
+        # metrics would be pinned forever and a redeploy under the
+        # same name expects a clean slot)
+        if self._admin_name is not None:
+            from bigdl_tpu.telemetry import admin as _admin
+            _srv = _admin.current()
+            if _srv is not None:
+                _srv.remove_source(self._admin_name)
 
     def __enter__(self) -> "InferenceService":
         return self

@@ -15,6 +15,19 @@ measurement-driven tuning both stand on):
   :class:`StallDetector` (stager starvation / host-sync stalls),
   :class:`MemoryWatermark` (device allocator gauges where available).
 
+Round 2 (ISSUE 11) made the stack externally visible and
+request-scoped:
+
+- :class:`RequestContext` — per-request trace context (trace_id,
+  tenant, deadline, ReplicaSet hop history) minted at ``submit()``,
+  fan-in flow arrows in the Chrome trace;
+- :class:`AdminServer` — ``/metrics`` (Prometheus text), ``/healthz``,
+  ``/trace``, ``/flight``, ``/profile?seconds=N`` on a loopback-only
+  stdlib http thread (``Config.admin_port``, off by default);
+- :class:`FlightRecorder` — crash-surviving structured-event JSONL
+  stream + bounded ring (``Config.flight_recorder_path``), joined with
+  traces by ``python -m tools.obs_report``.
+
 Enable for training via ``Config.telemetry_enabled`` /
 ``BIGDL_TPU_TELEMETRY=1`` or per-run with
 ``optimizer.set_telemetry(True, trace_path="trace.json")``.
@@ -24,6 +37,9 @@ host↔device sync, and leaves the loss sequence bitwise unchanged
 (gated in ``tests/test_telemetry.py``).
 """
 
+from bigdl_tpu.telemetry.admin import AdminServer, render_prometheus
+from bigdl_tpu.telemetry.context import RequestContext, new_trace_id
+from bigdl_tpu.telemetry.flight import FlightRecorder
 from bigdl_tpu.telemetry.hooks import DriverTelemetry
 from bigdl_tpu.telemetry.registry import (Counter, Gauge, Histogram,
                                           MetricRegistry, Reservoir)
@@ -33,7 +49,9 @@ from bigdl_tpu.telemetry.watchdog import (MemoryWatermark,
                                           jit_cache_size)
 
 __all__ = [
-    "Counter", "DriverTelemetry", "Gauge", "Histogram", "MemoryWatermark",
-    "MetricRegistry", "NULL_SPAN", "PHASE_CATS", "RecompileWatchdog",
-    "Reservoir", "StallDetector", "Tracer", "jit_cache_size",
+    "AdminServer", "Counter", "DriverTelemetry", "FlightRecorder", "Gauge",
+    "Histogram", "MemoryWatermark", "MetricRegistry", "NULL_SPAN",
+    "PHASE_CATS", "RecompileWatchdog", "RequestContext", "Reservoir",
+    "StallDetector", "Tracer", "jit_cache_size", "new_trace_id",
+    "render_prometheus",
 ]

@@ -86,6 +86,14 @@ class Reservoir:
         """Total values ever recorded (not just the retained window)."""
         return self._n
 
+    def window(self) -> List[float]:
+        """Copy of the retained sample window (unordered) — what the
+        set-level aggregation concatenates to compute cross-replica
+        percentiles (``ServingMetrics.aggregate``)."""
+        with self._lock:
+            n = min(self._n, len(self._buf))
+            return list(self._buf[:n])
+
     def percentiles(self, qs=(50, 95, 99)) -> Optional[Dict[str, float]]:
         with self._lock:
             n = min(self._n, len(self._buf))
@@ -145,6 +153,13 @@ class Histogram:
 
     def percentiles(self, qs=(50, 95, 99)) -> Optional[Dict[str, float]]:
         return self._res.percentiles(qs)
+
+    @property
+    def reservoir(self) -> Reservoir:
+        """The backing percentile window (``ServingMetrics`` exposes it
+        as the historical ``latency`` attribute; aggregation reads
+        ``.window()``)."""
+        return self._res
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -78,10 +78,13 @@ class Tracer:
     """Thread-safe span recorder with Chrome-trace JSON export.
 
     Events are stored as tuples ``(ph, name, cat, t0_ns, dur_ns, tid,
-    args)`` where ``ph`` is the Chrome phase ("X" complete span, "i"
-    instant) and ``tid`` is either a host thread id or a virtual track
-    name (the driver puts in-flight device blocks on a ``"device"``
-    track so they can overlap host spans without breaking nesting).
+    args, flow)`` where ``ph`` is the Chrome phase ("X" complete span,
+    "i" instant, "s"/"f" flow start/finish) and ``tid`` is either a
+    host thread id or a virtual track name (the driver puts in-flight
+    device blocks on a ``"device"`` track so they can overlap host
+    spans without breaking nesting).  ``flow`` is the flow-arrow id for
+    "s"/"f" events (None otherwise) — the serving engine uses flows to
+    fan N coalesced request spans into their one dispatch span.
     """
 
     def __init__(self, enabled: bool = True, capacity: int = 200_000):
@@ -107,6 +110,29 @@ class Tracer:
         self._record("i", name, cat, time.perf_counter_ns(), 0,
                      args or None)
 
+    def flow_start(self, name: str, fid: int, cat: Optional[str] = None,
+                   **args) -> None:
+        """Open one side of a Chrome flow arrow (``ph:"s"``).  Emit it
+        INSIDE an open span on the emitting thread — flow events bind to
+        the enclosing slice whose time range contains them.  ``fid``
+        pairs starts with finishes (``telemetry.context.flow_id``); the
+        request-fan-in edges in the serving trace are N ``flow_start``s
+        (one per coalesced request's submit span) finishing in the one
+        dispatch span."""
+        if not self.enabled:
+            return
+        self._record("s", name, cat, time.perf_counter_ns(), 0,
+                     args or None, flow=fid)
+
+    def flow_end(self, name: str, fid: int, cat: Optional[str] = None,
+                 **args) -> None:
+        """Close a flow arrow (``ph:"f"``, binding to the ENCLOSING
+        slice — ``bp:"e"``); emit inside the consuming span."""
+        if not self.enabled:
+            return
+        self._record("f", name, cat, time.perf_counter_ns(), 0,
+                     args or None, flow=fid)
+
     def record(self, name: str, t0_ns: int, t1_ns: int,
                cat: Optional[str] = None, track: Optional[str] = None,
                **args) -> None:
@@ -120,14 +146,16 @@ class Tracer:
         self._record("X", name, cat, t0_ns, max(0, t1_ns - t0_ns),
                      args or None, tid=track)
 
-    def _record(self, ph, name, cat, t0_ns, dur_ns, args, tid=None):
+    def _record(self, ph, name, cat, t0_ns, dur_ns, args, tid=None,
+                flow=None):
         if tid is None:
             tid = threading.get_ident()
         with self._lock:
             if len(self._events) >= self.capacity:
                 self._dropped += 1
                 return
-            self._events.append((ph, name, cat, t0_ns, dur_ns, tid, args))
+            self._events.append((ph, name, cat, t0_ns, dur_ns, tid, args,
+                                 flow))
 
     # -- reading -----------------------------------------------------------
     def events(self) -> List[Tuple]:
@@ -148,7 +176,7 @@ class Tracer:
         aggregate ``bench._measure`` consumes; the full self-time
         attribution lives in ``tools/trace_report.py``."""
         totals: Dict[str, float] = {}
-        for ph, _name, cat, _t0, dur_ns, _tid, _args in self.events():
+        for ph, _name, cat, _t0, dur_ns, _tid, _args, _flow in self.events():
             if ph != "X":
                 continue
             key = cat or "uncategorized"
@@ -169,11 +197,18 @@ class Tracer:
             return tid_map[tid]
 
         out = []
-        for ph, name, cat, t0_ns, dur_ns, tid, args in events:
+        for ph, name, cat, t0_ns, dur_ns, tid, args, flow in events:
             ev = {"name": name, "ph": ph, "pid": 0, "tid": tid_of(tid),
                   "ts": t0_ns / 1e3}
             if ph == "X":
                 ev["dur"] = dur_ns / 1e3
+            elif ph in ("s", "f"):
+                # flow arrow: id pairs the start with its finish; "f"
+                # binds to the ENCLOSING slice (bp:"e") so the arrow
+                # lands on the dispatch span, not the next slice
+                ev["id"] = flow
+                if ph == "f":
+                    ev["bp"] = "e"
             else:
                 ev["s"] = "t"
             if cat:

@@ -57,12 +57,18 @@ class RecompileWatchdog:
     """
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None, flight=None,
+                 trace_id: Optional[str] = None):
         self._seen: Dict[object, int] = {}
         self.events: List[Tuple[object, int, int]] = []  # (key, old, new)
         self._counter = (registry.counter("telemetry/recompiles")
                          if registry is not None else None)
         self._tracer = tracer
+        # optional flight recorder (+ the run's trace context): a
+        # steady-state recompile is exactly the kind of rare
+        # state-change the black box exists to keep
+        self._flight = flight
+        self._trace_id = trace_id
 
     def observe(self, key, cache_size: Optional[int]) -> bool:
         """Returns True when this observation flagged a recompile."""
@@ -78,6 +84,10 @@ class RecompileWatchdog:
         if self._tracer is not None:
             self._tracer.instant("recompile", key=str(key),
                                  cache_size=cache_size)
+        if self._flight is not None:
+            self._flight.record("recompile", cat="driver",
+                                trace_id=self._trace_id, key=str(key),
+                                cache_size=cache_size)
         logger.warning(
             "recompile watchdog: jit cache for %r grew %d -> %d after "
             "warmup — a steady-state retrace (GL106 discipline; check "

@@ -140,6 +140,30 @@ class Config:
     telemetry_enabled: bool = False
     telemetry_trace_path: str = ""
     telemetry_trace_capacity: int = 200_000  # retained spans, then drop+count
+    # admin plane (telemetry/admin.py): a stdlib http.server thread
+    # serving /metrics (Prometheus text), /healthz (JSON), /trace
+    # (Chrome-trace dump), /flight (flight-recorder ring) and
+    # /profile?seconds=N (on-demand jax.profiler capture).  0 (default)
+    # = OFF — no socket, no thread, provably inert.  Binds 127.0.0.1
+    # only (no auth on this surface — see README "Admin plane").
+    # Env: BIGDL_TPU_ADMIN_PORT.
+    admin_port: int = 0
+    # request-scoped tracing (telemetry/context.py): mint a
+    # RequestContext (trace_id, tenant, hop history, Chrome flow
+    # events) per serving submit and propagate it through coalescing,
+    # dispatch and ReplicaSet failover.  Off (default) = no context
+    # object is ever allocated — the serving path is byte-identical.
+    # Env: BIGDL_TPU_REQUEST_TRACING.
+    request_tracing: bool = False
+    # flight recorder (telemetry/flight.py): append-and-flush JSONL
+    # stream of structured events (health transitions, breaker trips,
+    # failovers, sheds, rollbacks, recompiles, checkpoint commits,
+    # preemption) with trace_id correlation — survives SIGKILL, joined
+    # with a trace by `python -m tools.obs_report`.  "" (default) =
+    # OFF — nothing allocated, nothing opened.  Env:
+    # BIGDL_TPU_FLIGHT_RECORDER_PATH / _CAPACITY.
+    flight_recorder_path: str = ""
+    flight_recorder_capacity: int = 4096  # in-memory ring bound
     # mesh defaults (dryrun/tests override explicitly)
     mesh_data: int = -1
     mesh_model: int = 1

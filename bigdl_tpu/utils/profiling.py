@@ -105,6 +105,33 @@ def format_times(times: List[LayerTime]) -> str:
     return "\n".join(lines)
 
 
+def profile_window(seconds: float, log_dir: Optional[str] = None,
+                   tracer=None) -> str:
+    """Wall-clock ``jax.profiler`` capture: whatever the process is
+    doing for the next ``seconds`` lands in the xplane trace (open with
+    TensorBoard).  The admin plane's ``/profile?seconds=N`` endpoint is
+    a thin shim over this — the on-demand deep dive for a live serving
+    process, where there is no single ``step_fn`` to hand to
+    :func:`profile_step`.  Returns the log dir.
+
+    Same divergence note as :func:`profile_step`: this is the opt-in,
+    off-the-hot-path tool — never the always-on path (the always-on
+    surfaces are the tracer and /metrics, which never sync)."""
+    import tempfile
+    import time as _time
+    from contextlib import nullcontext
+
+    if log_dir is None:
+        log_dir = tempfile.mkdtemp(prefix="bigdl_tpu_profile_")
+    span = (tracer.span("jax_profiler_window", cat="profiler",
+                        log_dir=log_dir, seconds=seconds)
+            if tracer is not None else nullcontext())
+    with span:
+        with jax.profiler.trace(log_dir):
+            _time.sleep(float(seconds))
+    return log_dir
+
+
 def profile_step(step_fn, *args, log_dir: str, steps: int = 3,
                  tracer=None):
     """Run ``step_fn(*args)`` under the jax profiler (xplane trace in

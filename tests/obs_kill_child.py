@@ -1,0 +1,60 @@
+"""Subprocess child for the flight-recorder SIGKILL test
+(tests/test_obs_plane.py).
+
+Runs a 2-replica ReplicaSet with request tracing ON and a flight
+recorder streaming to the path in argv[1], drives one request through
+a seeded replica-death failover (so the dump contains the full victim
+story: request_route → replica_death/failover → request_route → ok),
+prints ``READY`` on stdout, then blocks forever — the parent SIGKILLs
+it.  The point of the test: the flight recorder flushes per event, so
+even a SIGKILL (no atexit, no finally, no signal handler runs) leaves
+a parseable dump with the whole story on disk.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from bigdl_tpu import nn  # noqa: E402
+from bigdl_tpu.resilience import ReplicaSet  # noqa: E402
+from bigdl_tpu.resilience.faults import FaultInjector  # noqa: E402
+from bigdl_tpu.resilience.health import HealthPolicy  # noqa: E402
+from bigdl_tpu.telemetry import FlightRecorder, Tracer  # noqa: E402
+from bigdl_tpu.telemetry.context import RequestContext  # noqa: E402
+
+DIN = 8
+
+
+def main():
+    flight_path, trace_path = sys.argv[1], sys.argv[2]
+    model = nn.Sequential(nn.Linear(DIN, 16), nn.ReLU(),
+                          nn.Linear(16, 4), nn.SoftMax()).initialize(0)
+    x = np.random.default_rng(0).normal(0, 1, (1, DIN)).astype(np.float32)
+    flight = FlightRecorder(flight_path)
+    tracer = Tracer()
+    rs = ReplicaSet(
+        model, n_replicas=2, input_spec=((DIN,), np.float32),
+        max_batch_size=4, batch_timeout_ms=0.0, deadline_ms=0,
+        fault_injector=FaultInjector("replica_death@target=0,at=0",
+                                     seed=0),
+        tracer=tracer, flight=flight, request_tracing=True,
+        health=HealthPolicy(probe_backoff_s=0.05))
+    ctx = RequestContext(tenant="kill-test")
+    fut = rs.submit(x, ctx=ctx, timeout=30)
+    fut.result(30)  # resolves via failover; flight has the story
+    # the trace file is dumped cleanly BEFORE the kill — the kill test
+    # is about the FLIGHT stream surviving; the trace is the join input
+    tracer.dump(trace_path)
+    assert any(e["event"] == "failover"
+               for e in flight.events_for(ctx.trace_id)), "no failover?"
+    print(f"READY {ctx.trace_id}", flush=True)
+    while True:  # parent SIGKILLs us here — nothing below ever runs
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    main()

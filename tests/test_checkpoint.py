@@ -20,6 +20,7 @@ The ISSUE-7 acceptance surface:
 """
 
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -512,6 +513,27 @@ def _wait_for_step(losses_path, step, proc, timeout=90):
     raise AssertionError(f"child never reached step {step}")
 
 
+def _wait_for_commit(ckpt_dir, proc, timeout=90):
+    """Wait until at least one snapshot has COMMITTED (a ``model.N``
+    file, not a ``.tmp``).  The async writer trails the driver loop, so
+    'the loss log passed step 8' does not imply 'model.3 is on disk' —
+    killing in that gap leaves the resume child nothing valid and the
+    test flakes on writer-thread scheduling instead of testing the
+    resume path (a latent race surfaced by the obs-plane PR's timing
+    shifts)."""
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if os.path.isdir(ckpt_dir) and any(
+                re.fullmatch(r"model\.\d+", f)
+                for f in os.listdir(ckpt_dir)):
+            return
+        if proc.poll() is not None:
+            return  # a finished child drained its writer — committed
+        time.sleep(0.02)
+    proc.kill()
+    raise AssertionError("no snapshot ever committed")
+
+
 def _parse_losses(path):
     out = {}
     for line in open(path).read().splitlines():
@@ -572,6 +594,7 @@ class TestSubprocessFaultInjection:
                            str(iters), "--k", str(k)], wait=False)
         try:
             _wait_for_step(la, 8, proc)  # past model.6, mid-epoch
+            _wait_for_commit(d, proc)  # ... and ≥1 snapshot ON DISK
         finally:
             proc.kill()
         proc.wait(timeout=30)

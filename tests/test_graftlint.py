@@ -747,6 +747,26 @@ class TestRealTree:
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 
+    def test_obs_plane_modules_lint_clean(self):
+        """Standalone gate for the observability round-2 surface
+        (ISSUE-11): the admin plane, flight recorder and request
+        context are pure host-side plumbing (http.server thread,
+        JSONL stream, id minting — no jax anywhere near a hot path),
+        and the two reporting tools are offline file-joiners.  A
+        violation here means observability code grew a traced-scope
+        hazard — exactly what the "events ride existing boundaries"
+        catalog note forbids."""
+        result = lint_paths([
+            os.path.join(REPO, "bigdl_tpu", "telemetry", "admin.py"),
+            os.path.join(REPO, "bigdl_tpu", "telemetry", "flight.py"),
+            os.path.join(REPO, "bigdl_tpu", "telemetry", "context.py"),
+            os.path.join(REPO, "tools", "obs_report.py"),
+            os.path.join(REPO, "tools", "trace_report.py"),
+        ])
+        assert result.files_scanned == 5
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
     def test_checkpoint_package_lints_clean(self):
         """Same standalone discipline for the checkpoint package: its
         one device fetch (snapshot.capture_to_host) is only legal at

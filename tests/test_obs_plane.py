@@ -1,0 +1,824 @@
+"""Observability round-2 tests (ISSUE-11 acceptance surface).
+
+- RequestContext: minting, uniqueness, hop history, flow ids;
+- Tracer flow events: Chrome ``s``/``f`` schema, id pairing;
+- FlightRecorder: bounded ring, crash-surviving JSONL stream (torn
+  tails skipped), one-shot dump, rotation, trace_id correlation;
+- Prometheus rendering: exposition-format validity, escaping, summary
+  quantiles incl. per-bucket serving reservoirs;
+- AdminServer: /metrics, /healthz (200/503), /trace, /flight, 404s,
+  loopback binding, config-driven maybe_start inertness;
+- E2E (the acceptance demo): a live ReplicaSet under threaded load is
+  scraped mid-flight — /metrics contains serving latency quantiles and
+  resilience counters; a replica-kill run leaves a flight dump in
+  which the victim request's trace_id links its original dispatch, the
+  quarantine, and the successful failover hop;
+- SIGKILL survival: a subprocess is SIGKILL'd after staging failover
+  traffic; the parent parses the surviving dump with tools/obs_report;
+- obs_report: hand-computed fixture timeline (the trace_report fixture
+  pattern) and CLI exit codes;
+- trace_report satellite: resilience instants folded into the stall
+  picture, events-by-category accounting, --events CLI section;
+- ServingMetrics window-bias audit + ReplicaSet.stats() aggregation
+  regression;
+- inertness: everything off → no context objects, no extra threads.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.serving import InferenceService
+from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.telemetry import (AdminServer, FlightRecorder,
+                                 MetricRegistry, RequestContext, Tracer,
+                                 render_prometheus)
+from bigdl_tpu.telemetry import admin as admin_mod
+from bigdl_tpu.telemetry import flight as flight_mod
+from bigdl_tpu.telemetry.context import flow_id, new_trace_id
+from bigdl_tpu.telemetry.flight import load_dump
+from tools import obs_report, trace_report
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+FLIGHT_FIXTURE = os.path.join(FIXTURES, "flight_postmortem.jsonl")
+TRACE_FIXTURE = os.path.join(FIXTURES, "trace_postmortem.json")
+T1 = "aabbccdd00000001"
+T2 = "aabbccdd00000002"
+
+
+@pytest.fixture(autouse=True)
+def _isolate_singletons():
+    """No test may leak a process-wide admin server or flight recorder
+    into its neighbors (they are config-driven singletons)."""
+    admin_mod.reset()
+    flight_mod.reset()
+    yield
+    admin_mod.reset()
+    flight_mod.reset()
+
+
+def small_model(din=8, dout=4):
+    return nn.Sequential(nn.Linear(din, 16), nn.ReLU(),
+                         nn.Linear(16, dout), nn.SoftMax()).initialize(0)
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+# ==========================================================================
+# RequestContext
+# ==========================================================================
+class TestRequestContext:
+    def test_mint_unique_and_flow_id(self):
+        ids = {new_trace_id() for _ in range(500)}
+        assert len(ids) == 500
+        for t in list(ids)[:5]:
+            assert len(t) == 16 and int(t, 16) >= 0
+            assert 0 < flow_id(t) < 2 ** 63
+        c = RequestContext(tenant="acme", parent="p0")
+        assert c.flow_id == flow_id(c.trace_id)
+        assert c.tenant == "acme" and c.parent == "p0"
+
+    def test_hop_history(self):
+        c = RequestContext()
+        h0 = c.add_hop(0)
+        h0["outcome"] = "ReplicaDeadError"
+        h1 = c.add_hop(2, probe=True)
+        h1["outcome"] = "ok"
+        snap = c.snapshot()
+        assert snap["hops"] == [
+            {"replica": 0, "probe": False, "outcome": "ReplicaDeadError"},
+            {"replica": 2, "probe": True, "outcome": "ok"}]
+        assert "r0:ReplicaDeadError" in repr(c) and "r2:ok" in repr(c)
+
+
+# ==========================================================================
+# tracer flow events
+# ==========================================================================
+class TestTracerFlows:
+    def test_flow_schema_and_pairing(self):
+        t = Tracer()
+        c = RequestContext()
+        with t.span("request_submit", cat="serving"):
+            t.flow_start("req", c.flow_id, cat="serving")
+        with t.span("dispatch", cat="serving"):
+            t.flow_end("req", c.flow_id, cat="serving")
+        evs = t.to_chrome_trace()["traceEvents"]
+        s = next(e for e in evs if e["ph"] == "s")
+        f = next(e for e in evs if e["ph"] == "f")
+        assert s["id"] == f["id"] == c.flow_id
+        assert f["bp"] == "e" and "bp" not in s
+        assert "dur" not in s and "s" not in s  # not an instant
+        # disabled tracer: flows are free no-ops
+        off = Tracer(enabled=False)
+        off.flow_start("req", 1)
+        off.flow_end("req", 1)
+        assert off.events() == []
+
+
+# ==========================================================================
+# flight recorder
+# ==========================================================================
+class TestFlightRecorder:
+    def test_ring_bounded(self):
+        fl = FlightRecorder(capacity=4)
+        for i in range(10):
+            fl.record("e", n=i)
+        evs = fl.events()
+        assert len(evs) == 4 and evs[-1]["n"] == 9 and evs[0]["n"] == 6
+
+    def test_stream_survives_and_torn_tail_skipped(self, tmp_path):
+        p = str(tmp_path / "fl.jsonl")
+        fl = FlightRecorder(p)
+        fl.record("failover", cat="resilience", trace_id="t1", replica=0)
+        fl.record("revival", cat="resilience", replica=0)
+        # simulate the SIGKILL torn tail: half a JSON line
+        with open(p, "a") as f:
+            f.write('{"event": "lost_to_the_k')
+        blob = load_dump(p)
+        assert blob["meta"]["pid"] == os.getpid()
+        assert {"unix_ns", "perf_ns"} <= set(blob["meta"])
+        assert [e["event"] for e in blob["events"]] == ["failover",
+                                                        "revival"]
+        assert blob["events"][0]["trace_id"] == "t1"
+
+    def test_dump_object_form_roundtrip(self, tmp_path):
+        fl = FlightRecorder()  # memory-only
+        fl.record("breaker_trip", cat="resilience", version="m:v2")
+        path = fl.dump(str(tmp_path / "dump.json"))
+        blob = load_dump(path)
+        assert blob["events"][0]["event"] == "breaker_trip"
+
+    def test_rotation_bounds_disk(self, tmp_path):
+        p = str(tmp_path / "fl.jsonl")
+        fl = FlightRecorder(p, max_bytes=1 << 16)
+        for i in range(2000):
+            fl.record("spam", payload="x" * 64, n=i)
+        assert os.path.exists(p + ".1")
+        assert os.path.getsize(p) <= (1 << 16) + 4096
+        # the live file is still a valid stream after rotation
+        blob = load_dump(p)
+        assert blob["events"] and blob["meta"].get("pid")
+
+    def test_events_for_and_counts(self):
+        fl = FlightRecorder()
+        fl.record("failover", trace_id="a", replica=0)
+        fl.record("failover", trace_id="b", replica=1)
+        fl.record("shed")
+        assert [e["trace_id"] for e in fl.events_for("a")] == ["a"]
+        assert fl.counts() == {"failover": 2, "shed": 1}
+
+    def test_restart_respects_existing_file_size(self, tmp_path):
+        """The rotation bound must hold ACROSS process restarts: a
+        fresh recorder appending to an existing file inherits its size
+        into the rotation accounting instead of starting from zero."""
+        p = str(tmp_path / "fl.jsonl")
+        fl1 = FlightRecorder(p, max_bytes=1 << 16)
+        for i in range(300):
+            fl1.record("run1", payload="x" * 64, n=i)
+        fl1.close()
+        size_before = os.path.getsize(p)
+        fl2 = FlightRecorder(p, max_bytes=1 << 16)
+        for i in range(300):
+            fl2.record("run2", payload="x" * 64, n=i)
+        fl2.close()
+        assert os.path.exists(p + ".1")  # rotated across the restart
+        assert os.path.getsize(p) < size_before + (1 << 16)
+
+    def test_from_config_inert_and_live(self, tmp_path):
+        from bigdl_tpu.utils.config import configure, reset_config
+        assert flight_mod.from_config() is None  # default: off
+        p = str(tmp_path / "cfg.jsonl")
+        configure(flight_recorder_path=p)
+        try:
+            fl = flight_mod.from_config()
+            assert fl is not None and fl.path == p
+            assert flight_mod.from_config() is fl  # singleton
+        finally:
+            reset_config()
+            flight_mod.reset()
+
+
+# ==========================================================================
+# prometheus rendering
+# ==========================================================================
+_PROM_LINE = (r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+              r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+              r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? [0-9eE+.\-]+$')
+
+
+class TestPrometheusRender:
+    def test_families_types_and_format(self):
+        import re
+        reg = MetricRegistry()
+        reg.counter("resilience/failovers").inc(2)
+        reg.gauge("driver/device_wait_fraction").set(0.75)
+        h = reg.histogram("serving/latency_s")
+        for v in (0.001, 0.002, 0.01):
+            h.observe(v)
+        reg.histogram("serving/latency_s_bucket4").observe(0.004)
+        text = render_prometheus({"m/r0": reg.snapshot()})
+        lines = text.strip().split("\n")
+        pat = re.compile(_PROM_LINE)
+        for ln in lines:
+            assert ln.startswith("# TYPE ") or pat.match(ln), ln
+        assert "# TYPE bigdl_tpu_resilience_failovers counter" in text
+        assert 'bigdl_tpu_resilience_failovers{source="m/r0"} 2' in text
+        assert "# TYPE bigdl_tpu_serving_latency_s summary" in text
+        assert ('bigdl_tpu_serving_latency_s{source="m/r0",'
+                'quantile="0.99"}') in text
+        # the per-bucket serving reservoir is its own family
+        assert "bigdl_tpu_serving_latency_s_bucket4_count" in text
+        assert 'bigdl_tpu_serving_latency_s_count{source="m/r0"} 3' in text
+
+    def test_label_escaping_and_merge(self):
+        reg1, reg2 = MetricRegistry(), MetricRegistry()
+        reg1.counter("c").inc()
+        reg2.counter("c").inc(5)
+        text = render_prometheus({'a"b\\c': reg1.snapshot(),
+                                  "r1": reg2.snapshot()})
+        assert text.count("# TYPE bigdl_tpu_c counter") == 1  # merged
+        assert r'{source="a\"b\\c"} 1' in text
+
+
+# ==========================================================================
+# admin server
+# ==========================================================================
+class TestAdminServer:
+    def test_endpoints(self, tmp_path):
+        reg = MetricRegistry()
+        reg.counter("serving/requests_completed").inc(7)
+        tr = Tracer()
+        with tr.span("x", cat="serving"):
+            pass
+        fl = FlightRecorder()
+        fl.record("shed", cat="resilience")
+        with AdminServer(port=0) as srv:
+            srv.add_registry("m", reg).add_tracer("m", tr)
+            srv.add_health("m", lambda: {"ok": True, "detail": 1})
+            srv.set_flight(fl)
+            assert srv.host == "127.0.0.1" and srv.port > 0
+            code, text = _get(srv.url("/metrics"))
+            assert code == 200
+            assert ('bigdl_tpu_serving_requests_completed{source="m"} 7'
+                    in text)
+            code, body = _get(srv.url("/healthz"))
+            hz = json.loads(body)
+            assert code == 200 and hz["ok"] is True
+            assert hz["sources"]["m"]["detail"] == 1
+            code, body = _get(srv.url("/trace"))
+            assert code == 200
+            assert any(e.get("name") == "x"
+                       for e in json.loads(body)["traceEvents"])
+            code, body = _get(srv.url("/flight"))
+            assert json.loads(body)["events"][0]["event"] == "shed"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url("/nope"))
+            assert ei.value.code == 404
+
+    def test_healthz_503_on_unhealthy_source(self):
+        with AdminServer(port=0) as srv:
+            srv.add_health("sick", lambda: {"ok": False, "why": "dead"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url("/healthz"))
+            assert ei.value.code == 503
+            hz = json.loads(ei.value.read().decode())
+            assert hz["ok"] is False
+
+    def test_broken_health_provider_is_a_health_signal(self):
+        def boom():
+            raise RuntimeError("probe exploded")
+
+        with AdminServer(port=0) as srv:
+            srv.add_health("broken", boom)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url("/healthz"))
+            assert ei.value.code == 503
+            hz = json.loads(ei.value.read().decode())
+            assert "probe exploded" in hz["sources"]["broken"]["error"]
+
+    def test_remove_source_and_unique_names(self):
+        with AdminServer(port=0) as srv:
+            reg = MetricRegistry()
+            reg.counter("c").inc()
+            srv.add_registry("m", reg)
+            srv.add_health("m", lambda: {"ok": False})
+            # a second instance with the same natural name gets a
+            # distinct slot instead of silently overwriting the first;
+            # names are RESERVED at mint time, so two racing callers
+            # can't both be handed the same one
+            assert srv.unique_source_name("m") == "m-2"
+            assert srv.unique_source_name("m") == "m-3"
+            assert srv.unique_source_name("fresh") == "fresh"
+            assert srv.unique_source_name("fresh") == "fresh-2"
+            assert srv.health_json()["ok"] is False
+            # a stopped source deregisters: health recovers and its
+            # metrics leave the scrape page
+            srv.remove_source("m")
+            assert srv.health_json() == {"ok": True, "sources": {}}
+            assert 'source="m"' not in srv.metrics_text()
+
+    def test_shared_tracer_exports_once_in_trace_json(self):
+        """A ReplicaSet and its replicas register the SAME tracer
+        under N+1 names — /trace must export it once, not N+1 times."""
+        tr = Tracer()
+        with tr.span("x", cat="serving"):
+            pass
+        with AdminServer(port=0) as srv:
+            srv.add_tracer("set", tr)
+            srv.add_tracer("set/r0", tr)
+            srv.add_tracer("set/r1", tr)
+            out = srv.trace_json()
+            spans = [e for e in out["traceEvents"]
+                     if e.get("name") == "x"]
+            assert len(spans) == 1
+            assert out["otherData"]["sources"] == ["set"]
+
+    def test_bind_failure_degrades_monitoring_not_serving(self):
+        """A taken admin port must not crash product constructors —
+        maybe_start() logs once and returns None."""
+        import socket
+        from bigdl_tpu.utils.config import configure, reset_config
+        blocker = socket.socket()
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        try:
+            configure(admin_port=port)
+            assert admin_mod.maybe_start() is None  # degraded, no raise
+            assert admin_mod.maybe_start() is None  # remembered
+            # ... and a service still constructs fine through the path
+            svc = InferenceService(small_model(),
+                                   input_spec=((8,), np.float32),
+                                   max_batch_size=2,
+                                   batch_timeout_ms=0.0, name="degraded")
+            svc.predict(np.zeros((1, 8), np.float32))
+            svc.stop()
+        finally:
+            blocker.close()
+            reset_config()
+
+    def test_maybe_start_inert_by_default(self):
+        assert admin_mod.maybe_start() is None  # admin_port=0
+        assert admin_mod.current() is None
+        assert not any(t.name == "bigdl-tpu-admin"
+                       for t in threading.enumerate())
+
+    def test_maybe_start_from_config(self):
+        from bigdl_tpu.utils.config import configure, reset_config
+        configure(admin_port=0)  # explicit off first
+        assert admin_mod.maybe_start() is None
+        try:
+            # port 0 means off by contract, so pick an ephemeral port
+            # by starting a throwaway server and reusing its port is
+            # racy — instead configure a high odd port
+            import socket
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            configure(admin_port=port)
+            srv = admin_mod.maybe_start()
+            assert srv is not None and srv.port == port
+            assert admin_mod.maybe_start() is srv  # idempotent
+        finally:
+            reset_config()
+            admin_mod.reset()
+
+
+# ==========================================================================
+# E2E acceptance: live scrape during serving load
+# ==========================================================================
+class TestServingScrapeE2E:
+    def test_metrics_scrape_during_live_replica_set_load(self):
+        from bigdl_tpu.resilience import ReplicaSet
+        srv = AdminServer(port=0)
+        srv.start()
+        admin_mod.install(srv)
+        model = small_model()
+        rng = np.random.default_rng(0)
+        try:
+            rs = ReplicaSet(model, n_replicas=2,
+                            input_spec=((8,), np.float32),
+                            max_batch_size=8, batch_timeout_ms=1.0,
+                            deadline_ms=0, name="scrape")
+            stop = threading.Event()
+            errs = []
+
+            def worker():
+                x = rng.normal(0, 1, (1, 8)).astype(np.float32)
+                while not stop.is_set():
+                    try:
+                        rs.predict(x, timeout=30)
+                    except Exception as e:
+                        errs.append(e)
+                        return
+
+            threads = [threading.Thread(target=worker) for _ in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                deadline = time.monotonic() + 10.0
+                text = ""
+                while time.monotonic() < deadline:
+                    # scrape MID-LOAD: quantiles appear once completions
+                    # land in the reservoir
+                    _, text = _get(srv.url("/metrics"))
+                    if ('quantile="0.99"' in text
+                            and "bigdl_tpu_serving_latency_s" in text):
+                        break
+                    time.sleep(0.05)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(10)
+            assert not errs, errs
+            # serving latency quantiles, per replica source
+            assert "bigdl_tpu_serving_latency_s" in text
+            assert 'quantile="0.99"' in text
+            assert 'source="scrape/r0"' in text
+            # resilience counters from the set-level registry
+            assert "bigdl_tpu_resilience_failovers" in text
+            assert "bigdl_tpu_resilience_sheds" in text
+            # healthz agrees the set is healthy
+            code, body = _get(srv.url("/healthz"))
+            hz = json.loads(body)
+            assert code == 200 and hz["sources"]["scrape"]["ok"] is True
+            rs.stop()
+            # a stopped set deregisters — its parked replicas must not
+            # read as a permanent 503 (and its metrics leave /metrics)
+            code, body = _get(srv.url("/healthz"))
+            assert code == 200
+            assert "scrape" not in json.loads(body)["sources"]
+            _, text = _get(srv.url("/metrics"))
+            assert 'source="scrape/r0"' not in text
+        finally:
+            admin_mod.reset()
+
+
+# ==========================================================================
+# E2E acceptance: replica-kill story in the flight dump
+# ==========================================================================
+class TestFailoverStory:
+    # the injected ReplicaDeathFault kills the batcher thread ON
+    # PURPOSE (that is the scenario); pytest must not flag the planned
+    # thread death as an unhandled-exception warning
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_trace_id_links_dispatch_quarantine_and_failover(
+            self, tmp_path):
+        from bigdl_tpu.resilience import ReplicaSet
+        from bigdl_tpu.resilience.faults import FaultInjector
+        from bigdl_tpu.resilience.health import HealthPolicy
+        fl = FlightRecorder(str(tmp_path / "fl.jsonl"))
+        tr = Tracer()
+        rs = ReplicaSet(
+            small_model(), n_replicas=2, input_spec=((8,), np.float32),
+            max_batch_size=4, batch_timeout_ms=0.0, deadline_ms=0,
+            fault_injector=FaultInjector("replica_death@target=0,at=0",
+                                         seed=0),
+            tracer=tr, flight=fl, request_tracing=True,
+            health=HealthPolicy(probe_backoff_s=0.05))
+        x = np.zeros((1, 8), np.float32)
+        ctx = RequestContext(tenant="t")
+        y = rs.submit(x, ctx=ctx, timeout=30).result(30)
+        assert y.shape == (1, 4)
+        # hop history: victim hop then the successful failover hop
+        assert ctx.hops[0]["replica"] == 0
+        assert ctx.hops[0]["outcome"] == "ReplicaDeadError"
+        assert ctx.hops[-1]["outcome"] == "ok"
+        assert len(ctx.hops) == 2
+        # the dump links the story BY TRACE ID: the failover (carrying
+        # the original-dispatch replica in its hops), then the retry
+        # route.  First attempts are deliberately NOT flight events —
+        # routine traffic must not evict the rare events from the ring.
+        story = fl.events_for(ctx.trace_id)
+        assert [e["event"] for e in story] == ["failover",
+                                               "request_route"]
+        failover = story[0]
+        assert failover["replica"] == 0  # the original dispatch
+        assert failover["hops"] == ["r0:ReplicaDeadError"]
+        assert story[1]["replica"] == 1 and story[1]["attempt"] == 2
+        # ... and the un-keyed resilience events are there too.  The
+        # death is handled on the SUPERVISOR thread, which may still be
+        # mid-bookkeeping when the caller's future resolves via the
+        # failover — poll boundedly instead of racing it
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            all_events = fl.counts()
+            if (all_events.get("replica_death", 0) >= 1
+                    and all_events.get("health_transition", 0) >= 1):
+                break
+            time.sleep(0.01)
+        assert all_events.get("replica_death", 0) >= 1, all_events
+        assert all_events.get("health_transition", 0) >= 1, all_events
+        rs.stop()
+        # the tracer saw the dispatch spans + flow edges for this id
+        trace = tr.to_chrome_trace()["traceEvents"]
+        flows = [e for e in trace if e.get("ph") in ("s", "f")
+                 and e.get("id") == ctx.flow_id]
+        assert any(e["ph"] == "s" for e in flows)
+        assert any(e["ph"] == "f" for e in flows)
+        # obs_report joins both into one story
+        tp = str(tmp_path / "trace.json")
+        tr.dump(tp)
+        report = obs_report.summarize(
+            load_dump(fl.path), trace=trace_report.load_trace(tp))
+        req = next(r for r in report["requests"]
+                   if r["trace_id"] == ctx.trace_id)
+        assert req["failed_over"] is True
+        assert "dispatch" in req["events"]  # the original dispatch span
+
+
+# ==========================================================================
+# SIGKILL survival (subprocess)
+# ==========================================================================
+class TestSigkillSurvival:
+    def test_flight_dump_survives_sigkill(self, tmp_path):
+        flight_path = str(tmp_path / "kill.jsonl")
+        trace_path = str(tmp_path / "kill_trace.json")
+        child = os.path.join(os.path.dirname(__file__),
+                             "obs_kill_child.py")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = (repo + os.pathsep + env.get("PYTHONPATH", "")
+                             ).rstrip(os.pathsep)
+        proc = subprocess.Popen(
+            [sys.executable, child, flight_path, trace_path],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo)
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("READY "), (
+                line, proc.stderr.read() if proc.poll() is not None
+                else "")
+            trace_id = line.split()[1]
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(30)
+            assert proc.returncode == -signal.SIGKILL
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        # the stream survived the kill and tells the whole story
+        blob = load_dump(flight_path)
+        events = [e["event"] for e in blob["events"]
+                  if e.get("trace_id") == trace_id]
+        assert events == ["failover", "request_route"]
+        # ... and obs_report parses it (CLI, with the trace joined)
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.obs_report", flight_path,
+             "--trace", trace_path, "--json"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stderr
+        report = json.loads(r.stdout)
+        assert report["n_failed_over"] == 1
+        victim = next(q for q in report["requests"]
+                      if q["trace_id"] == trace_id)
+        assert victim["failed_over"] is True
+
+
+# ==========================================================================
+# obs_report fixture (hand-computed timeline)
+# ==========================================================================
+class TestObsReportFixture:
+    def test_fixture_timeline_exact(self):
+        report = obs_report.summarize(
+            load_dump(FLIGHT_FIXTURE),
+            trace=trace_report.load_trace(TRACE_FIXTURE))
+        assert report["meta"] == {"pid": 4242, "schema": 1,
+                                  "trace_joined": True}
+        # 5 flight events + (2 submits + 2 fan-in dispatch rows +
+        # 1 failover instant) from the trace; the driver-pipeline span
+        # in the fixture must NOT appear
+        assert report["n_rows"] == 10
+        assert report["event_counts"] == {
+            "checkpoint_commit": 1, "dispatch": 2, "failover": 2,
+            "replica_death": 1, "request_route": 2, "request_submit": 2}
+        assert report["categories"] == {"driver": 1, "resilience": 5,
+                                        "serving": 4}
+        assert report["n_requests"] == 3  # T1, T2, the run's trace id
+        assert report["n_failed_over"] == 1
+        t1 = next(r for r in report["requests"]
+                  if r["trace_id"] == T1)
+        # hand-computed ordering on the unified wall clock: submit
+        # (.005) < dispatch (.008) < route (.010) < flight failover
+        # (.021) < trace failover (.0215) < retry route (.022)
+        assert t1["events"] == ["request_submit", "dispatch",
+                                "request_route", "failover", "failover",
+                                "request_route"]
+        assert t1["failed_over"] is True
+        t2 = next(r for r in report["requests"]
+                  if r["trace_id"] == T2)
+        assert t2["events"] == ["request_submit", "dispatch"]
+        assert t2["failed_over"] is False
+        # clock alignment: the first timeline row is the T1 submit at
+        # wall 1700000000.005 exactly (µs-exact anchor arithmetic)
+        first = report["timeline"][0]
+        assert first["name"] == "request_submit"
+        assert first["t_unix"] == pytest.approx(1_700_000_000.005,
+                                                abs=1e-6)
+
+    def test_trace_id_filter(self):
+        report = obs_report.summarize(load_dump(FLIGHT_FIXTURE),
+                                      trace_id=T1)
+        assert report["n_rows"] == 3  # route, failover, route
+        assert list(report["event_counts"]) == ["failover",
+                                                "request_route"]
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        assert obs_report.main([FLIGHT_FIXTURE]) == 0
+        out = capsys.readouterr().out
+        assert "failed-over requests" in out and T1 in out
+        assert obs_report.main(
+            [FLIGHT_FIXTURE, "--trace", TRACE_FIXTURE, "--json"]) == 0
+        json.loads(capsys.readouterr().out)
+        assert obs_report.main([str(tmp_path / "missing.jsonl")]) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert obs_report.main([str(empty)]) == 2
+
+
+# ==========================================================================
+# trace_report satellite: resilience instants folded in, --events
+# ==========================================================================
+class TestTraceReportEvents:
+    def _trace_with_resilience(self, tmp_path):
+        t = Tracer()
+        with t.span("dispatch", cat="dispatch"):
+            pass
+        t.instant("recompile", key="k")  # cat watchdog (default)
+        t.instant("failover", cat="resilience", replica=0,
+                  error="ReplicaDeadError")
+        t.instant("replica_death", cat="resilience", replica=0)
+        t.instant("shed", cat="resilience")
+        t.instant("mystery", cat="something_new")
+        p = str(tmp_path / "t.json")
+        t.dump(p)
+        return trace_report.summarize(trace_report.load_trace(p))
+
+    def test_resilience_fold_and_category_accounting(self, tmp_path):
+        report = self._trace_with_resilience(tmp_path)
+        assert report["resilience_events"] == {"failover": 1,
+                                               "replica_death": 1,
+                                               "shed": 1}
+        assert report["stall"]["disruption_events"] == 3
+        # watchdog split keeps its historical content
+        assert report["watchdog_events"] == {"recompile": 1}
+        # NOTHING is silently ignored: unknown categories are accounted
+        assert report["events_by_category"]["something_new"] == {
+            "mystery": 1}
+        assert report["events_by_category"]["resilience"][
+            "failover"] == 1
+        names = [r["name"] for r in report["event_timeline"]]
+        assert set(names) == {"recompile", "failover", "replica_death",
+                              "shed", "mystery"}
+
+    def test_events_cli_section(self, tmp_path, capsys):
+        t = Tracer()
+        with t.span("dispatch", cat="dispatch"):
+            pass
+        t.instant("failover", cat="resilience", replica=3)
+        p = str(tmp_path / "t.json")
+        t.dump(p)
+        assert trace_report.main([p, "--events"]) == 0
+        out = capsys.readouterr().out
+        assert "instant-event timeline" in out
+        assert "[resilience] failover" in out and '"replica": 3' in out
+        # without the flag the timeline section is absent
+        assert trace_report.main([p]) == 0
+        out = capsys.readouterr().out
+        assert "instant-event timeline" not in out
+        assert "disruption event(s)" in out
+
+    def test_pipeline_fixture_has_zero_disruptions(self):
+        # the PR-6 fixture (watchdog instants only) reads as a clean
+        # run under the new fold
+        fix = os.path.join(FIXTURES, "trace_pipeline.json")
+        report = trace_report.summarize(trace_report.load_trace(fix))
+        assert report["stall"]["disruption_events"] == 0
+        assert report["resilience_events"] == {}
+        assert report["watchdog_events"] == {"recompile": 2,
+                                             "stager_starvation": 1}
+
+
+# ==========================================================================
+# ServingMetrics window bias + ReplicaSet aggregation (satellite audit)
+# ==========================================================================
+class TestThroughputWindowAudit:
+    def test_snapshot_uses_activity_window_not_uptime(self):
+        m = ServingMetrics()
+        # an idle service reports 0, not 0/uptime noise
+        assert m.snapshot()["throughput_rps"] == 0.0
+        m.record_submit(1)
+        m.record_done(10, 0.001)
+        time.sleep(0.05)
+        m.record_done(10, 0.001)
+        snap = m.snapshot()
+        # trailing idle must NOT dilute the rate: wait well past the
+        # activity window, re-snapshot, the rate is unchanged
+        time.sleep(0.25)
+        snap2 = m.snapshot()
+        assert snap2["throughput_rps"] == pytest.approx(
+            snap["throughput_rps"], rel=0.01)
+        assert snap2["throughput_window_s"] == snap["throughput_window_s"]
+        assert snap2["uptime_s"] > snap2["throughput_window_s"]
+
+    def test_aggregate_is_not_replica_zero(self):
+        m0, m1 = ServingMetrics(), ServingMetrics()
+        m0.record_submit(1)
+        m0.record_done(5, 0.001, bucket=1)
+        time.sleep(0.12)
+        m1.record_submit(1)
+        m1.record_done(45, 0.009, bucket=4)
+        agg = ServingMetrics.aggregate([m0, m1], queue_depth=3)
+        assert agg["requests_completed"] == 50
+        assert agg["n_sources"] == 2 and agg["queue_depth"] == 3
+        # rate over the UNION window (>= the 0.12 s stagger), so it is
+        # far below the per-replica burst rates a naive replica-0 (or
+        # sum-of-rates) read would report
+        assert agg["throughput_window_s"] >= 0.12
+        assert agg["throughput_rps"] <= 50 / 0.12 + 1
+        r0_rps = m0.snapshot()["throughput_rps"]
+        assert r0_rps > agg["throughput_rps"]  # replica-0 bias is real
+        # latency percentiles come from the CONCATENATED windows: the
+        # max must be replica 1's 9 ms even though replica 0 never saw
+        # it, and both buckets appear
+        assert agg["latency_ms"]["max"] == pytest.approx(9.0)
+        assert set(agg["latency_ms_by_bucket"]) == {1, 4}
+
+    def test_replica_set_stats_aggregate(self):
+        from bigdl_tpu.resilience import ReplicaSet
+        rs = ReplicaSet(small_model(), n_replicas=2,
+                        input_spec=((8,), np.float32),
+                        max_batch_size=4, batch_timeout_ms=0.0,
+                        deadline_ms=0, name="aggtest")
+        x = np.zeros((1, 8), np.float32)
+        for _ in range(6):
+            rs.predict(x, timeout=30)
+        stats = rs.stats()
+        agg = stats["aggregate"]
+        per_replica = sum(r["requests_completed"]
+                          for r in stats["replicas"])
+        assert agg["requests_completed"] == per_replica == 6
+        assert agg["throughput_rps"] > 0
+        assert agg["latency_ms"]["count"] if "count" in (
+            agg["latency_ms"] or {}) else agg["latency_ms"] is not None
+        rs.stop()
+
+
+# ==========================================================================
+# inertness: everything off
+# ==========================================================================
+class TestObsInertness:
+    def test_config_defaults_are_off(self):
+        from bigdl_tpu.utils.config import Config
+        cfg = Config()
+        assert cfg.admin_port == 0
+        assert cfg.request_tracing is False
+        assert cfg.flight_recorder_path == ""
+
+    def test_serving_path_allocates_nothing_when_off(self):
+        before = {t.name for t in threading.enumerate()}
+        svc = InferenceService(small_model(), input_spec=((8,),
+                                                          np.float32),
+                               max_batch_size=4, batch_timeout_ms=0.0,
+                               name="inert")
+        captured = []
+        orig = svc._dispatch
+
+        def spy(requests):
+            captured.extend(requests)
+            orig(requests)
+
+        svc._batcher._dispatch_fn = spy
+        svc.predict(np.zeros((2, 8), np.float32))
+        svc.stop()
+        # no context was ever allocated, no tracer attached
+        assert captured and all(r.ctx is None for r in captured)
+        assert svc.tracer is None and svc._request_tracing is False
+        # no admin/flight singletons came alive
+        assert admin_mod.current() is None
+        assert flight_mod.current() is None
+        after = {t.name for t in threading.enumerate()}
+        assert "bigdl-tpu-admin" not in after
+        # only the (now stopped) batcher thread ever existed beyond the
+        # baseline set
+        assert not {n for n in after - before
+                    if not n.startswith("inert-batcher")}
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

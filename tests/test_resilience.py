@@ -536,6 +536,42 @@ class TestReplicaSet:
 class TestReplicaSetReviewRegressions:
     """Post-review hardening gates (PR-10 code review)."""
 
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_routing_path_death_sweeps_stranded_running_requests(self):
+        """Regression (found by the obs-plane PR's deadline-less load):
+        a request mid-dispatch at replica death is marked RUNNING, so
+        revive's backlog cancellation can't touch it — and if the
+        ROUTING path revived the replica before the supervisor's next
+        liveness poll, ``svc.alive`` read True again and the stranded
+        request hung until its deadline (forever, with none).  The
+        death handler now sweeps the dead replica's inflight entries
+        itself.  Supervisor disabled here so only that sweep can
+        rescue the victim."""
+        rs = ReplicaSet(
+            make_model(), n_replicas=2, input_spec=SPEC16,
+            max_batch_size=4, batch_timeout_ms=0.0, deadline_ms=0,
+            fault_injector=FaultInjector("replica_death@target=0,at=0",
+                                         seed=0),
+            name="stranded",
+            health=HealthPolicy(probe_backoff_s=30.0))
+        # no supervisor: the poll must not be what rescues the victim
+        rs._ensure_supervisor_locked = lambda: None
+        x = rows(np.random.default_rng(0), 1)
+        victim = rs.submit(x)  # routed to r0, dies mid-dispatch
+        deadline = time.monotonic() + 5.0
+        while rs.replica(0).alive and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not rs.replica(0).alive, "death fault never fired"
+        assert not victim.done()  # stranded: RUNNING on a dead batcher
+        # the next routed request spots the dead batcher — the handler
+        # must revive AND fail the victim over, not just revive
+        other = rs.submit(x)
+        np.testing.assert_allclose(np.asarray(other.result(10.0)),
+                                   np.asarray(victim.result(10.0)))
+        assert rs.stats()["resilience"]["resilience/failovers"] >= 1
+        rs.stop()
+
     def test_both_quarantined_replicas_readmit(self):
         # regression: _pick used to consume EVERY due replica's one
         # probation-probe slot while dispatching only one, leaking

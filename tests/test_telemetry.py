@@ -460,8 +460,29 @@ class TestTelemetryInert:
     def test_bitwise_identical_loss_and_dispatch_count(self, k, tmp_path):
         """THE acceptance gate: telemetry on changes NOTHING observable
         about training — per-step losses bitwise equal, same number of
-        jit dispatches — while still emitting a valid trace."""
+        jit dispatches — while still emitting a valid trace.
+
+        Round 2 extends the gate to the full new surface: with the
+        admin plane off, the flight recorder off and request tracing
+        off (all defaults), the run allocates NO admin server, NO
+        flight recorder, NO request contexts and ZERO extra threads —
+        and the loss sequence/dispatch count remain the off-path
+        numbers."""
+        from bigdl_tpu.telemetry import admin as admin_mod
+        from bigdl_tpu.telemetry import flight as flight_mod
+        threads_before = {t.ident for t in threading.enumerate()}
         rec_off, opt_off, n_off = run_counted(k, telemetry=False)
+        # the new observability surface stayed entirely un-allocated
+        assert admin_mod.current() is None
+        assert flight_mod.current() is None
+        assert opt_off._flight is None
+        surviving = [t for t in threading.enumerate()
+                     if t.ident not in threads_before and t.is_alive()]
+        assert not [t for t in surviving
+                    if t.name == "bigdl-tpu-admin"], surviving
+        # zero extra threads: whatever transient helpers ran (stager
+        # producer), nothing new outlives the run
+        assert not surviving, surviving
         trace = str(tmp_path / f"trace_k{k}.json")
         rec_on, opt_on, n_on = run_counted(k, telemetry=True,
                                            trace_path=trace)
@@ -539,6 +560,23 @@ class TestConfigSurface:
         assert cfg.telemetry_enabled is False
         assert cfg.telemetry_trace_path == ""
         assert cfg.telemetry_trace_capacity == 200_000
+        # round 2 (admin plane / flight recorder / request tracing):
+        # every new knob defaults to the provably-inert state
+        assert cfg.admin_port == 0
+        assert cfg.request_tracing is False
+        assert cfg.flight_recorder_path == ""
+        assert cfg.flight_recorder_capacity == 4096
+
+    def test_round2_env_knobs(self, monkeypatch):
+        from bigdl_tpu.utils.config import Config
+        monkeypatch.setenv("BIGDL_TPU_ADMIN_PORT", "9187")
+        monkeypatch.setenv("BIGDL_TPU_REQUEST_TRACING", "1")
+        monkeypatch.setenv("BIGDL_TPU_FLIGHT_RECORDER_PATH",
+                           "/tmp/fl.jsonl")
+        cfg = Config.from_env()
+        assert cfg.admin_port == 9187
+        assert cfg.request_tracing is True
+        assert cfg.flight_recorder_path == "/tmp/fl.jsonl"
 
     def test_env_alias(self, monkeypatch):
         from bigdl_tpu.utils.config import Config

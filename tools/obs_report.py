@@ -1,0 +1,224 @@
+"""obs_report — join a flight-recorder dump with a telemetry trace.
+
+The post-mortem tool of the observability stack: the flight recorder
+(``bigdl_tpu/telemetry/flight.py``) leaves a crash-surviving JSONL
+stream of structured events (failovers, quarantines, breaker trips,
+checkpoint commits, ...), and the tracer leaves a Chrome-trace JSON of
+spans/instants.  Each alone is half the story — this tool merges them
+onto ONE wall-clock axis and groups by ``trace_id``, so "what happened
+to request X" reads as a timeline:
+
+    12:03:01.123  [resilience] request_route   replica=0   trace=ab12…
+    12:03:01.640  [resilience] replica_death   replica=0
+    12:03:01.641  [resilience] failover        replica=0 → retry
+    12:03:01.644  [resilience] request_route   replica=2
+    12:03:01.650  [serving]    dispatch        ok
+
+Clock alignment: the flight meta header records a paired
+``(unix_ns, perf_ns)`` anchor sampled at recorder creation; tracer
+timestamps are ``perf_counter_ns``-based microseconds, so
+``wall = (ts_us·1e3 − perf_ns + unix_ns) / 1e9`` places trace events on
+the same axis (only valid for a trace from the SAME process as the
+dump — obs_report says so when the pids disagree is unknowable, so it
+just aligns).
+
+Usage::
+
+    python -m tools.obs_report flight.jsonl
+    python -m tools.obs_report flight.jsonl --trace trace.json
+    python -m tools.obs_report flight.jsonl --trace-id ab12cd34ef56aa01
+    python -m tools.obs_report flight.jsonl --json
+
+Exit codes: 0 = report printed, 2 = unreadable/invalid input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from bigdl_tpu.telemetry.flight import load_dump
+
+# trace categories worth folding into a post-mortem timeline (driver
+# pipeline spans are volume, not story — trace_report covers those)
+_STORY_CATS = {"resilience", "serving", "driver"}
+
+
+def _wall_from_trace_ts(ts_us: float, meta: dict) -> Optional[float]:
+    """Chrome-trace ts (µs, perf_counter base) → unix seconds, via the
+    flight meta's paired clock anchor.  None when the dump predates the
+    anchor fields."""
+    if not meta or "perf_ns" not in meta or "unix_ns" not in meta:
+        return None
+    return (ts_us * 1e3 - meta["perf_ns"] + meta["unix_ns"]) / 1e9
+
+
+def _trace_story_rows(trace: dict, meta: dict) -> List[dict]:
+    rows = []
+    for e in trace.get("traceEvents", []):
+        ph = e.get("ph")
+        cat = e.get("cat")
+        if cat not in _STORY_CATS or ph not in ("X", "i"):
+            continue
+        wall = _wall_from_trace_ts(e.get("ts", 0.0), meta)
+        if wall is None:
+            continue
+        args = e.get("args") or {}
+        row = {"t_unix": wall, "src": "trace",
+               "kind": "span" if ph == "X" else "instant",
+               "name": e.get("name"), "cat": cat}
+        detail = {k: v for k, v in args.items() if k != "trace_ids"}
+        if detail.get("trace_id"):
+            row["trace_id"] = detail.pop("trace_id")
+        if detail:
+            row["args"] = detail
+        fan_in = args.get("trace_ids") or []
+        if fan_in:
+            # a serving dispatch span fans in N requests — one timeline
+            # row per request so every story sees its dispatch
+            rows.extend({**row, "trace_id": t} for t in fan_in)
+        else:
+            rows.append(row)
+    return rows
+
+
+def summarize(flight_blob: dict, trace: Optional[dict] = None,
+              trace_id: Optional[str] = None) -> dict:
+    """Merge one flight dump (``telemetry.flight.load_dump``) and an
+    optional Chrome trace into the report dict (the schema the fixture
+    test gates)."""
+    meta = flight_blob.get("meta") or {}
+    events = list(flight_blob.get("events") or [])
+    if not events and trace is None:
+        raise ValueError("flight dump contains no events")
+
+    timeline: List[dict] = []
+    for e in events:
+        row = {"t_unix": float(e.get("t_unix", 0.0)), "src": "flight",
+               "kind": "event", "name": e.get("event"),
+               "cat": e.get("cat", "event")}
+        if e.get("trace_id"):
+            row["trace_id"] = e["trace_id"]
+        detail = {k: v for k, v in e.items()
+                  if k not in ("event", "cat", "t_unix", "perf_ns",
+                               "trace_id")}
+        if detail:
+            row["args"] = detail
+        timeline.append(row)
+    if trace is not None:
+        timeline.extend(_trace_story_rows(trace, meta))
+    timeline.sort(key=lambda r: r["t_unix"])
+
+    if trace_id is not None:
+        timeline = [r for r in timeline
+                    if r.get("trace_id") == trace_id]
+
+    counts: Dict[str, int] = defaultdict(int)
+    cats: Dict[str, int] = defaultdict(int)
+    for r in timeline:
+        counts[r["name"]] += 1
+        cats[r["cat"]] += 1
+
+    # per-request stories: every trace_id seen, with its ordered rows;
+    # "failed_over" flags the ones worth reading first
+    stories: Dict[str, List[dict]] = defaultdict(list)
+    for r in timeline:
+        if r.get("trace_id"):
+            stories[r["trace_id"]].append(r)
+    requests = []
+    for tid, rows in sorted(stories.items()):
+        names = [r["name"] for r in rows]
+        requests.append({
+            "trace_id": tid,
+            "n_events": len(rows),
+            "failed_over": "failover" in names,
+            "events": names,
+            "t_first": rows[0]["t_unix"],
+            "t_last": rows[-1]["t_unix"],
+        })
+
+    return {
+        "meta": {"pid": meta.get("pid"), "schema": meta.get("schema"),
+                 "trace_joined": trace is not None},
+        "event_counts": dict(sorted(counts.items())),
+        "categories": dict(sorted(cats.items())),
+        "n_rows": len(timeline),
+        "n_requests": len(requests),
+        "n_failed_over": sum(1 for r in requests if r["failed_over"]),
+        "requests": requests,
+        "timeline": timeline,
+    }
+
+
+def _fmt_t(t_unix: float) -> str:
+    frac = f"{t_unix % 1:.3f}"[1:]
+    return time.strftime("%H:%M:%S", time.localtime(t_unix)) + frac
+
+
+def _render(report: dict, limit: int = 200) -> str:
+    lines = [f"flight dump: {report['n_rows']} timeline rows, "
+             f"{report['n_requests']} traced request(s), "
+             f"{report['n_failed_over']} failed over"
+             + ("" if report["meta"]["trace_joined"]
+                else "  (no trace joined — pass --trace)")]
+    lines.append("event counts: " + (", ".join(
+        f"{k}×{v}" for k, v in report["event_counts"].items())
+        or "none"))
+    lines.append("timeline:")
+    for r in report["timeline"][:limit]:
+        tid = f"  trace={r['trace_id'][:8]}…" if r.get("trace_id") else ""
+        args = ""
+        if r.get("args"):
+            args = "  " + json.dumps(r["args"], sort_keys=True,
+                                     default=str)
+        src = "fl" if r["src"] == "flight" else "tr"
+        lines.append(f"  {_fmt_t(r['t_unix'])}  {src} [{r['cat']:<10}] "
+                     f"{r['name']}{tid}{args}")
+    if len(report["timeline"]) > limit:
+        lines.append(f"  ... {len(report['timeline']) - limit} more "
+                     f"(use --json)")
+    failed = [r for r in report["requests"] if r["failed_over"]]
+    if failed:
+        lines.append("failed-over requests:")
+        for r in failed:
+            lines.append(f"  {r['trace_id']}: " + " → ".join(r["events"]))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.obs_report",
+        description="Join a flight-recorder dump with a telemetry "
+                    "trace into a post-mortem timeline")
+    p.add_argument("flight", help="flight-recorder JSONL stream or "
+                                  "dump() JSON (FlightRecorder)")
+    p.add_argument("--trace", help="Chrome-trace JSON from the same "
+                                   "process (Tracer.dump / /trace)")
+    p.add_argument("--trace-id", dest="trace_id",
+                   help="only the timeline of one request/run")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the report as JSON")
+    p.add_argument("--limit", type=int, default=200,
+                   help="max timeline rows in the human rendering")
+    args = p.parse_args(argv)
+    try:
+        blob = load_dump(args.flight)
+        trace = None
+        if args.trace:
+            from tools.trace_report import load_trace
+            trace = load_trace(args.trace)
+        report = summarize(blob, trace=trace, trace_id=args.trace_id)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"obs_report: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, default=str) if args.as_json
+          else _render(report, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,15 +12,23 @@ driver-pipeline picture the raw timeline buries:
 - **top spans** — by total duration, with call counts and mean;
 - **stall picture** — device-wait fraction (host blocked on device —
   healthy when the device is the bottleneck) vs host-stage fraction
-  (device starved by the input pipeline);
+  (device starved by the input pipeline), plus the DISRUPTION count:
+  resilience instants (failover, quarantine, replica death, shed,
+  breaker trip, rollback) folded in, because a stall picture that
+  ignores the failovers that caused the stalls is half a picture;
 - **watchdog events** — recompiles, stager starvations, host-sync
-  stalls (instant events the watchdogs injected).
+  stalls (instant events the watchdogs injected);
+- **instant events by category** — EVERY ``ph:"i"`` event grouped by
+  its ``cat`` (watchdog / resilience / anything a future subsystem
+  emits), so no category is silently ignored; ``--events`` prints the
+  chronological listing with args (the incident timeline).
 
 Usage::
 
     python -m tools.trace_report trace.json
     python -m tools.trace_report trace.json --json
     python -m tools.trace_report trace.json --top 20
+    python -m tools.trace_report trace.json --events
 
 Virtual tracks (the ``device`` track carrying in-flight block spans,
 category ``pipeline``) overlap the host timeline by design and are
@@ -116,12 +124,28 @@ def summarize(trace: dict, top: int = 10) -> dict:
         r["total_ms"] = round(r.pop("total_us") / 1e3, 3)
         r["mean_ms"] = round(r["total_ms"] / r["count"], 4)
 
+    # instants: EVERY category is accounted (a resilience failover or a
+    # category some future subsystem invents must not vanish from the
+    # report just because this tool predates it)
     watchdog = defaultdict(int)
+    resilience = defaultdict(int)
+    by_category: Dict[str, Dict[str, int]] = defaultdict(
+        lambda: defaultdict(int))
     recompiles = []
+    timeline = []
     for e in instants:
-        watchdog[e["name"]] += 1
+        cat = e.get("cat") or "uncategorized"
+        by_category[cat][e["name"]] += 1
+        if cat == "resilience":
+            resilience[e["name"]] += 1
+        elif cat in ("watchdog", "uncategorized"):
+            watchdog[e["name"]] += 1
         if e["name"] == "recompile":
             recompiles.append(e.get("args", {}))
+        timeline.append({"t_ms": round((e["ts"] - t0) / 1e3, 3),
+                         "cat": cat, "name": e["name"],
+                         "args": e.get("args", {})})
+    timeline.sort(key=lambda r: r["t_ms"])
 
     other = trace.get("otherData", {})
     return {
@@ -135,14 +159,22 @@ def summarize(trace: dict, top: int = 10) -> dict:
             "device_wait_fraction": share.get("device_wait", 0.0),
             "host_stage_fraction": share.get("stage", 0.0),
             "dispatch_fraction": share.get("dispatch", 0.0),
+            # the disruption fold (satellite of the admin-plane PR): a
+            # wait spike with failovers behind it reads differently
+            # from one without
+            "disruption_events": int(sum(resilience.values())),
         },
         "recompile_events": recompiles,
         "watchdog_events": dict(watchdog),
+        "resilience_events": dict(resilience),
+        "events_by_category": {c: dict(n)
+                               for c, n in sorted(by_category.items())},
+        "event_timeline": timeline,
         "top_spans": top_spans,
     }
 
 
-def _render(report: dict) -> str:
+def _render(report: dict, events: bool = False) -> str:
     lines = [f"wall {report['wall_s'] * 1e3:.1f} ms, "
              f"{report['span_count']} spans"
              + (f" ({report['dropped_events']} dropped)"
@@ -155,7 +187,8 @@ def _render(report: dict) -> str:
     lines.append(
         f"stall picture: device_wait {st['device_wait_fraction']:.3f} "
         f"(host blocked on device), host_stage "
-        f"{st['host_stage_fraction']:.3f} (device starved by input)")
+        f"{st['host_stage_fraction']:.3f} (device starved by input), "
+        f"{st['disruption_events']} disruption event(s)")
     if report["watchdog_events"]:
         lines.append("watchdog events: " + ", ".join(
             f"{k}×{v}" for k, v in sorted(
@@ -164,6 +197,20 @@ def _render(report: dict) -> str:
             lines.append(f"  recompile: {r}")
     else:
         lines.append("watchdog events: none")
+    if report["resilience_events"]:
+        lines.append("resilience events: " + ", ".join(
+            f"{k}×{v}" for k, v in sorted(
+                report["resilience_events"].items())))
+    if events:
+        lines.append("instant-event timeline (t from first span):")
+        rows = report["event_timeline"]
+        for r in rows[:200]:
+            args = (" " + json.dumps(r["args"], sort_keys=True)
+                    if r["args"] else "")
+            lines.append(f"  {r['t_ms']:>10.3f} ms  [{r['cat']}] "
+                         f"{r['name']}{args}")
+        if len(rows) > 200:
+            lines.append(f"  ... {len(rows) - 200} more (use --json)")
     lines.append(f"top spans:")
     w = max((len(r["name"]) for r in report["top_spans"]), default=8)
     lines.append(f"  {'span':<{w}}  {'count':>6}  {'total(ms)':>10}  "
@@ -183,13 +230,17 @@ def main(argv=None) -> int:
                    help="emit the report as JSON")
     p.add_argument("--top", type=int, default=10,
                    help="how many top spans to show")
+    p.add_argument("--events", action="store_true",
+                   help="print the chronological instant-event "
+                        "timeline (watchdog + resilience)")
     args = p.parse_args(argv)
     try:
         report = summarize(load_trace(args.trace), top=args.top)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"trace_report: {e}", file=sys.stderr)
         return 2
-    print(json.dumps(report) if args.as_json else _render(report))
+    print(json.dumps(report) if args.as_json
+          else _render(report, events=args.events))
     return 0
 
 
