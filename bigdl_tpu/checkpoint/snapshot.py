@@ -391,9 +391,12 @@ class AsyncSnapshotWriter:
         # by the time the error surfaces the failing submit is long gone
         self._q: "queue.Queue[Optional[tuple]]" = \
             queue.Queue(maxsize=max(1, int(capacity)))
-        self._error: Optional[BaseException] = None
-        self._error_context: Optional[str] = None
         self._lock = threading.Lock()
+        # deferred-failure cell: set by the writer thread, consumed
+        # (and cleared) by submit/drain on the driver thread
+        self._error: Optional[BaseException] = None  # guarded-by: _lock
+        # guarded-by: _lock
+        self._error_context: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
         self._closed = False
 

@@ -237,7 +237,7 @@ class PredictionService:
         self.params, self.state = _resolve(model, params, state)
         self.batch_size = batch_size
         self._stats_lock = threading.Lock()
-        self.request_count = 0
+        self.request_count = 0  # guarded-by: _stats_lock
         # timeout 0 = adaptive batching: the historical service
         # dispatched immediately, so the shim must not tax lone
         # sequential callers with a coalescing wait — concurrent load

@@ -81,12 +81,15 @@ class ReplicaHealth:
         self._recorder = recorder
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = HEALTHY
-        self._consecutive_failures = 0
-        self._probes = 0
-        self._probe_inflight = False
+        # the whole ledger mutates under one lock; `state` is exposed
+        # as a lock-free read (stale by at most one transition)
+        self._state = HEALTHY                # write-guarded-by: _lock
+        self._consecutive_failures = 0       # guarded-by: _lock
+        self._probes = 0                     # guarded-by: _lock
+        self._probe_inflight = False         # guarded-by: _lock
+        # guarded-by: _lock
         self._backoff_s = self.policy.probe_backoff_s
-        self._next_probe_at = 0.0
+        self._next_probe_at = 0.0            # guarded-by: _lock
 
     # ------------------------------------------------------------ events
     def _count(self, name: str) -> None:
@@ -98,6 +101,7 @@ class ReplicaHealth:
             self._recorder.record("health_transition", cat="resilience",
                                   replica=self.ix, frm=frm, to=to)
 
+    # guarded-by: _lock
     def _quarantine_locked(self, now: float) -> None:
         if self._state != QUARANTINED:
             self._transition(self._state, QUARANTINED)
@@ -105,6 +109,7 @@ class ReplicaHealth:
             self._count("quarantines")
         self._schedule_probe_locked(now)
 
+    # guarded-by: _lock
     def _schedule_probe_locked(self, now: float) -> None:
         p = self.policy
         # deterministic jitter: pure function of (seed, replica, probe#)
@@ -236,16 +241,17 @@ class CircuitBreaker:
         self.trip_after = max(1, int(trip_after))
         self._recorder = recorder  # optional telemetry.FlightRecorder
         self._base_cooldown_s = float(cooldown_s)
-        self._cooldown_s = float(cooldown_s)
+        self._cooldown_s = float(cooldown_s)  # guarded-by: _lock
         self._cooldown_factor = float(cooldown_factor)
         self._cooldown_max_s = float(cooldown_max_s)
         self._registry = registry
         self._name = name
         self._clock = clock
         self._lock = threading.Lock()
-        self._consecutive_failures = 0
+        self._consecutive_failures = 0       # guarded-by: _lock
+        # guarded-by: _lock
         self._opened_at: Optional[float] = None
-        self.trips = 0
+        self.trips = 0                       # write-guarded-by: _lock
 
     def record_success(self) -> None:
         with self._lock:

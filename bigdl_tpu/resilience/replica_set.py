@@ -245,9 +245,13 @@ class ReplicaSet:
         # double-revive would double-count the death in the metrics
         self._death_locks = [threading.Lock()
                              for _ in range(len(self._replicas))]
-        self._inflight: dict = {}  # token -> (route, ix, inner, probe)
+        # token -> (route, ix, inner, probe); guarded-by: _lock
+        self._inflight: dict = {}
         self._token = itertools.count()
-        self._stopped = False
+        # lifecycle flag/thread: written under the lock, read lock-free
+        # on fast paths (submit's early refusal, stop's join)
+        self._stopped = False  # write-guarded-by: _lock
+        # write-guarded-by: _lock
         self._supervisor: Optional[threading.Thread] = None
         self._wake = threading.Condition(self._lock)
 
@@ -469,6 +473,7 @@ class ReplicaSet:
         _settle(route.outer, exc=exc)
 
     # -------------------------------------------------------- supervisor
+    # guarded-by: _lock
     def _ensure_supervisor_locked(self) -> None:
         if self._supervisor is None or not self._supervisor.is_alive():
             self._supervisor = threading.Thread(

@@ -146,10 +146,10 @@ class RequestBatcher:
         self.queue_capacity = int(queue_capacity)
         self._name = name
 
-        self._q: deque[_Request] = deque()
         self._cond = threading.Condition()
-        self._closed = False
-        self._drain = True
+        self._q: deque[_Request] = deque()  # guarded-by: _cond
+        self._closed = False                # guarded-by: _cond
+        self._drain = True                  # guarded-by: _cond
         self._thread: Optional[threading.Thread] = None
         self.cancelled_rows = 0
         # EWMA of seconds-per-request through dispatch, written only by
@@ -171,7 +171,9 @@ class RequestBatcher:
         if spr is None:
             return None
         if depth is None:
-            depth = len(self._q)
+            # racy-by-design depth sample: a retry hint, not an
+            # invariant (put() passes the locked depth in)
+            depth = len(self._q)  # graftlint: disable=GL201
         return round(min(max(depth * spr * 1e3, 1.0), 10_000.0), 1)
 
     def _note_dispatch(self, n_requests: int, elapsed_s: float) -> None:
@@ -202,7 +204,9 @@ class RequestBatcher:
         """Idempotent; tests construct services with ``start=False`` to
         stage a queue deterministically before the first dispatch."""
         if self._thread is None:
-            self.last_progress = time.monotonic()
+            # pre-start write: Thread.start() is the happens-before
+            # edge, so the batcher thread observes it without a lock
+            self.last_progress = time.monotonic()  # graftlint: disable=GL201
             self._thread = threading.Thread(
                 target=self._run, name=f"{self._name}-batcher", daemon=True)
             self._thread.start()
@@ -220,8 +224,11 @@ class RequestBatcher:
         ``start()`` (a parked batcher can still be started) and from a
         closed batcher (an orderly stop is not a death).  This is the
         liveness the ``ReplicaSet`` supervisor polls."""
+        # lock-free liveness sample BY DESIGN: the supervisor polls this
+        # from outside; a stale read just delays detection one poll
         return (self._thread is not None
-                and not self._thread.is_alive() and not self._closed)
+                and not self._thread.is_alive()
+                and not self._closed)  # graftlint: disable=GL201
 
     def close(self, drain: bool = True,
               timeout: Optional[float] = None) -> int:
@@ -278,6 +285,7 @@ class RequestBatcher:
 
     # -- batcher thread ----------------------------------------------------
     def _run(self) -> None:
+        drain = True
         while True:
             batch = self._collect(block=True)
             if batch:
@@ -286,8 +294,9 @@ class RequestBatcher:
             # empty collect while blocking only happens when closed
             with self._cond:
                 if self._closed and (not self._drain or not self._q):
+                    drain = self._drain  # captured under the lock
                     break
-        if not self._drain:
+        if not drain:
             self._cancel_backlog()
 
     def _collect(self, block: bool) -> List[_Request]:

@@ -80,7 +80,7 @@ class ServingMetrics:
         self.latency = self._latency_h.reservoir
         # per-row-bucket latency histograms, created as buckets see
         # traffic (registry get-or-create is atomic; the lock only
-        # guards the local cache dict)
+        # guards the local cache dict); guarded-by: _lock
         self._bucket_latency: Dict[int, Histogram] = {}
         # activity window (monotonic): first submit → last completion —
         # the unbiased throughput denominator (module docstring)
@@ -142,6 +142,10 @@ class ServingMetrics:
         self._t_last_done = time.monotonic()
         self._latency_h.observe(latency_s)
         if bucket is not None:
+            # lock-free fast-path read BY DESIGN: a GIL-atomic dict get
+            # racing the locked setdefault below at worst misses and
+            # falls into the locked path; record_done is per-request
+            # hot — graftlint: disable=GL201
             h = self._bucket_latency.get(bucket)
             if h is None:
                 with self._lock:  # lazy get-or-create, race-safe

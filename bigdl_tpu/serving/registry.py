@@ -86,16 +86,18 @@ class ModelRegistry:
                  breaker_cooldown_s: float = 30.0, registry=None,
                  flight=None):
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._services: Dict[Tuple[str, int], InferenceService] = {}
-        self._latest: Dict[str, int] = {}
+        self._latest: Dict[str, int] = {}  # guarded-by: _lock
         # keys mid-deploy (reserved before the slow AOT warmup)
-        self._pending: set[Tuple[str, int]] = set()
+        self._pending: set[Tuple[str, int]] = set()  # guarded-by: _lock
         # per-version circuit breakers (see module docstring); the
         # optional MetricRegistry receives resilience/breaker_trips and
         # resilience/breaker_fallbacks counters
         self._breaker_trip_after = int(breaker_trip_after)
         self._breaker_cooldown_s = float(breaker_cooldown_s)
         self._metrics = registry
+        # guarded-by: _lock
         self._breakers: Dict[Tuple[str, int], CircuitBreaker] = {}
         # flight recorder (telemetry round 2): breaker trips and
         # latest-wins fallbacks land there so a post-mortem sees WHICH
@@ -173,6 +175,7 @@ class ModelRegistry:
         return service
 
     # -- lookup ------------------------------------------------------------
+    # guarded-by: _lock
     def _resolve(self, name: str, version: Optional[int]) -> Tuple[str, int]:
         """Caller must hold ``self._lock`` (so error paths below must
         not re-take it — ``self._lock`` is not reentrant).

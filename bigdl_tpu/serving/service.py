@@ -226,12 +226,17 @@ class InferenceService:
             return out
 
         self._jit = jax.jit(fwd)
-        self._compiled: Dict[int, Any] = {}
-        self._warmed = False
-        self._row_spec = None
-        self._out_spec = None
-        self._out_row_shape: Optional[Tuple[int, ...]] = None
         self._warm_lock = threading.Lock()
+        # warmup state: written only under _warm_lock (warmup is the
+        # one writer); hot-path reads are lock-free and gated on the
+        # _warmed flag flipping LAST — readers never see a
+        # partially-populated bucket dict
+        self._compiled: Dict[int, Any] = {}  # write-guarded-by: _warm_lock
+        self._warmed = False                 # write-guarded-by: _warm_lock
+        self._row_spec = None                # write-guarded-by: _warm_lock
+        self._out_spec = None                # write-guarded-by: _warm_lock
+        # write-guarded-by: _warm_lock
+        self._out_row_shape: Optional[Tuple[int, ...]] = None
         # serializes batcher replacement vs shutdown: revive() (on a
         # supervisor/failover thread) swaps in a new batcher and
         # start()s it; a concurrent stop() must never observe the new
@@ -239,7 +244,7 @@ class InferenceService:
         # join() there raises "cannot join thread before it is
         # started" (race surfaced by the obs-plane failover tests)
         self._lifecycle_lock = threading.Lock()
-        self._stopped = False
+        self._stopped = False  # write-guarded-by: _lifecycle_lock
         self.metrics = ServingMetrics()
         # fault injection (resilience layer): the injector is consulted
         # per dispatch; _fault_replica is stamped by ReplicaSet so
@@ -268,7 +273,13 @@ class InferenceService:
             _srv.add_registry(self._admin_name, self.metrics.registry)
             if self.tracer is not None:
                 _srv.add_tracer(self._admin_name, self.tracer)
-        self._batcher = self._make_batcher()
+        # the batcher/finalizer pair is swapped atomically by revive()
+        # and retired by stop(), both under the lifecycle lock; readers
+        # (submit, queue_depth, alive) take the racy-by-design stale
+        # reference — a put() into a just-retired batcher raises
+        # ServiceClosed, which the caller already handles
+        self._batcher = self._make_batcher()  # write-guarded-by: _lifecycle_lock
+        # write-guarded-by: _lifecycle_lock
         self._finalizer = weakref.finalize(
             self, RequestBatcher.close, self._batcher, True, 5.0)
         if input_spec is not None:
@@ -371,6 +382,12 @@ class InferenceService:
                     lambda s: jax.ShapeDtypeStruct((b,) + s.shape, s.dtype),
                     row)
                 t0 = time.monotonic()
+                # deploy-time compile DELIBERATELY under the warm lock:
+                # serializing concurrent first-submitters until every
+                # bucket executable exists is the warmup contract (a
+                # half-warmed dict KeyErrors) — the one reviewed
+                # blocking-under-lock exception in the serving stack
+                # graftlint: disable=GL206
                 self._compiled[b] = self._jit.lower(
                     self.params, self.state, spec).compile()
                 timings[b] = round(time.monotonic() - t0, 4)

@@ -118,12 +118,14 @@ class AdminServer:
         self.requested_port = int(port)
         self.profile_dir = profile_dir
         self.port: Optional[int] = None
-        self._registries: Dict[str, object] = {}
-        self._tracers: Dict[str, object] = {}
-        self._health: Dict[str, Callable[[], dict]] = {}
-        self._reserved: set = set()  # names handed out, not yet bound
-        self._flight = None
         self._lock = threading.Lock()
+        self._registries: Dict[str, object] = {}  # guarded-by: _lock
+        self._tracers: Dict[str, object] = {}     # guarded-by: _lock
+        # guarded-by: _lock
+        self._health: Dict[str, Callable[[], dict]] = {}
+        # names handed out, not yet bound; guarded-by: _lock
+        self._reserved: set = set()
+        self._flight = None  # write-guarded-by: _lock
         self._profile_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -363,8 +365,9 @@ class AdminServer:
 
 
 # ------------------------------------------------- process-wide singleton
-_installed: Optional[AdminServer] = None
 _install_lock = threading.Lock()
+# write-guarded-by: _install_lock
+_installed: Optional[AdminServer] = None
 
 
 def install(server: Optional[AdminServer]) -> None:
@@ -379,7 +382,7 @@ def current() -> Optional[AdminServer]:
     return _installed
 
 
-_start_failed = False
+_start_failed = False  # write-guarded-by: _install_lock
 
 
 def maybe_start() -> Optional[AdminServer]:

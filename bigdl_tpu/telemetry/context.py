@@ -46,8 +46,8 @@ from typing import Dict, List, Optional
 # recycle ids within minutes under bench-level load and silently merge
 # two requests' stories in obs_report)
 _PREFIX = f"{os.getpid() & 0xffff:04x}{(time.time_ns() >> 10) & 0xffff:04x}"
-_SEQ = itertools.count(1)
 _LOCK = threading.Lock()
+_SEQ = itertools.count(1)  # guarded-by: _LOCK
 
 
 def new_trace_id() -> str:

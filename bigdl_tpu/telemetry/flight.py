@@ -66,10 +66,10 @@ class FlightRecorder:
         self.path = path or None
         self.capacity = max(1, int(capacity))
         self.max_bytes = max(1 << 16, int(max_bytes))
-        self._ring: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
-        self._file = None
-        self._bytes = 0
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._file = None   # guarded-by: _lock
+        self._bytes = 0     # guarded-by: _lock
         self.meta = {
             "schema": SCHEMA_VERSION,
             "pid": os.getpid(),
@@ -117,6 +117,7 @@ class FlightRecorder:
                     self._disable_stream_locked(e)
         return entry
 
+    # guarded-by: _lock  (also reached from __init__, pre-sharing)
     def _disable_stream_locked(self, exc: OSError) -> None:
         logger.warning(
             "flight recorder stream to %s failed (%s) — disk recording "
@@ -128,9 +129,10 @@ class FlightRecorder:
                 pass
         self._file = None
 
+    # guarded-by: _lock  (or __init__, before the object is shared)
     def _write_line(self, obj: dict) -> None:
-        # caller holds the lock (or is __init__); line-buffered file +
-        # explicit flush → a SIGKILL loses at most the in-flight line
+        # line-buffered file + explicit flush → a SIGKILL loses at most
+        # the in-flight line
         line = json.dumps(obj, default=str) + "\n"
         self._file.write(line)
         self._file.flush()
@@ -138,6 +140,7 @@ class FlightRecorder:
         if self._bytes > self.max_bytes:
             self._rotate_locked()
 
+    # guarded-by: _lock
     def _rotate_locked(self) -> None:
         try:
             self._file.close()
@@ -148,6 +151,7 @@ class FlightRecorder:
         self._bytes = 0
         self._write_header_after_rotate()
 
+    # guarded-by: _lock
     def _write_header_after_rotate(self) -> None:
         line = json.dumps({"meta": self.meta, "rotated": True}) + "\n"
         self._file.write(line)
@@ -236,8 +240,9 @@ def _looks_like_object_dump(path: str) -> bool:
 
 
 # ------------------------------------------------- process-wide singleton
-_installed: Optional[FlightRecorder] = None
 _install_lock = threading.Lock()
+# write-guarded-by: _install_lock
+_installed: Optional[FlightRecorder] = None
 
 
 def install(recorder: Optional[FlightRecorder]) -> None:

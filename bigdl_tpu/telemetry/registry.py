@@ -33,7 +33,7 @@ class Counter:
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
-        self._v = 0
+        self._v = 0  # write-guarded-by: _lock
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
@@ -72,9 +72,10 @@ class Reservoir:
     """
 
     def __init__(self, capacity: int = 4096):
-        self._buf = [0.0] * capacity
-        self._n = 0          # total ever recorded
         self._lock = threading.Lock()
+        self._buf = [0.0] * capacity  # guarded-by: _lock
+        # total ever recorded; write-guarded-by: _lock
+        self._n = 0
 
     def record(self, value: float) -> None:
         with self._lock:
@@ -123,10 +124,12 @@ class Histogram:
         self.name = name
         self._lock = threading.Lock()
         self._res = Reservoir(capacity)
-        self._sum = 0.0
-        self._count = 0
-        self._min: Optional[float] = None
-        self._max: Optional[float] = None
+        # exact accumulators: one locked writer (observe); the scalar
+        # properties read lock-free (stale-but-consistent floats)
+        self._sum = 0.0                       # write-guarded-by: _lock
+        self._count = 0                       # write-guarded-by: _lock
+        self._min: Optional[float] = None     # write-guarded-by: _lock
+        self._max: Optional[float] = None     # write-guarded-by: _lock
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -183,7 +186,7 @@ class MetricRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, object] = {}
+        self._metrics: Dict[str, object] = {}  # guarded-by: _lock
 
     def _get_or_create(self, name: str, cls, *args):
         with self._lock:
@@ -206,7 +209,8 @@ class MetricRegistry:
         return self._get_or_create(name, Histogram, capacity)
 
     def get(self, name: str):
-        return self._metrics.get(name)
+        with self._lock:
+            return self._metrics.get(name)
 
     def names(self) -> List[str]:
         with self._lock:

@@ -91,8 +91,8 @@ class Tracer:
         self.enabled = bool(enabled)
         self.capacity = int(capacity)
         self._lock = threading.Lock()
-        self._events: List[Tuple] = []
-        self._dropped = 0
+        self._events: List[Tuple] = []  # guarded-by: _lock
+        self._dropped = 0               # write-guarded-by: _lock
 
     # -- recording ---------------------------------------------------------
     def span(self, name: str, cat: Optional[str] = None, **args):

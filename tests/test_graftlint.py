@@ -490,12 +490,690 @@ class TestDriverLoopHostSync:
 
 
 # ===========================================================================
+# GL201 unguarded-shared-state
+# ===========================================================================
+SERV = "bigdl_tpu/serving/fake.py"
+
+
+class TestUnguardedSharedState:
+    def test_positive_annotated_attr_accessed_outside_lock(self):
+        vs = lint("""
+            import threading
+            class B:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._q = []   # guarded-by: _cond
+                    self._n = 0    # write-guarded-by: _cond
+                def bad_read(self):
+                    return len(self._q)
+                def bad_write(self):
+                    self._n = 5
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL201"] * 2
+        assert "read of `self._q`" in vs[0].message
+        assert "write to `self._n`" in vs[1].message
+
+    def test_negative_locked_access_and_write_guarded_read(self):
+        assert rule_ids("""
+            import threading
+            class B:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._q = []   # guarded-by: _cond
+                    self._n = 0    # write-guarded-by: _cond
+                def ok(self):
+                    with self._cond:
+                        self._q.append(1)
+                        self._n += 1
+                def ok_read(self):
+                    return self._n  # write-guarded: reads lock-free
+            """, path=SERV) == []
+
+    def test_negative_held_on_entry_def_annotation(self):
+        # the ModelRegistry._resolve contract: caller holds the lock,
+        # the def-line annotation makes the body check as locked
+        assert rule_ids("""
+            import threading
+            class R:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._services = {}  # guarded-by: _lock
+                # guarded-by: _lock
+                def _resolve(self, name):
+                    return self._services[name]
+                def get(self, name):
+                    with self._lock:
+                        return self._resolve(name)
+            """, path=SERV) == []
+
+    def test_negative_condition_aliasing_counts_as_the_lock(self):
+        # Condition(self._lock) IS self._lock (the ReplicaSet._wake
+        # shape): holding either guards attrs declared on the lock
+        assert rule_ids("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._wake = threading.Condition(self._lock)
+                    self._inflight = {}  # guarded-by: _lock
+                def a(self):
+                    with self._wake:
+                        self._inflight.clear()
+                def b(self):
+                    with self._lock:
+                        return len(self._inflight)
+            """, path=SERV) == []
+
+    def test_positive_heuristic_cross_thread_write_without_lock(self):
+        vs = lint("""
+            import threading
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = None
+                def start(self):
+                    self.value = 0
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+                def _run(self):
+                    self.value = 1
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL201"]
+        assert "spawned thread" in vs[0].message
+
+    def test_negative_heuristic_common_lock_on_both_writes(self):
+        assert rule_ids("""
+            import threading
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = None
+                def start(self):
+                    with self._lock:
+                        self.value = 0
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+                def _run(self):
+                    with self._lock:
+                        self.value = 1
+            """, path=SERV) == []
+
+    def test_positive_module_global_write_guard(self):
+        vs = lint("""
+            import threading
+            _install_lock = threading.Lock()
+            # write-guarded-by: _install_lock
+            _installed = None
+            def install(x):
+                global _installed
+                _installed = x
+            def current():
+                return _installed
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL201"]
+        assert vs[0].message.startswith("write to `_installed`")
+
+    def test_negative_local_shadow_of_guarded_global(self):
+        # review regression: a function-local variable (or parameter)
+        # that shadows an annotated module global is NOT the global —
+        # Python scoping makes every occurrence local
+        assert rule_ids("""
+            import threading
+            _install_lock = threading.Lock()
+            # write-guarded-by: _install_lock
+            _installed = None
+            def probe():
+                _installed = object()
+                return _installed
+            def probe2(_installed):
+                _installed = None
+                return _installed
+            def real_write(x):
+                global _installed
+                with _install_lock:
+                    _installed = x
+            """, path=SERV) == []
+
+    def test_negative_tests_are_out_of_scope(self):
+        src = """
+            import threading
+            class B:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._q = []   # guarded-by: _cond
+                def bad(self):
+                    return len(self._q)
+            """
+        assert rule_ids(src, path="tests/test_fake.py") == []
+
+
+# ===========================================================================
+# GL202 lock-retake / lock-ordering
+# ===========================================================================
+class TestLockRetake:
+    def test_positive_retake_via_method_call(self):
+        # the ModelRegistry._resolve deadlock class: an error path under
+        # the lock calls a helper that re-takes the same Lock
+        vs = lint("""
+            import threading
+            class R:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._services = {}
+                def get(self, name):
+                    with self._lock:
+                        if name not in self._services:
+                            raise KeyError(self.list_models())
+                        return self._services[name]
+                def list_models(self):
+                    with self._lock:
+                        return sorted(self._services)
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL202"]
+        assert "list_models" in vs[0].message
+        assert "re-take" in vs[0].message
+
+    def test_positive_direct_nested_with_same_lock(self):
+        vs = lint("""
+            import threading
+            class R:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL202"]
+
+    def test_negative_rlock_and_default_condition_are_reentrant(self):
+        assert rule_ids("""
+            import threading
+            class R:
+                def __init__(self):
+                    self._rlock = threading.RLock()
+                    self._cond = threading.Condition()
+                def f(self):
+                    with self._rlock:
+                        with self._rlock:
+                            pass
+                def g(self):
+                    with self._cond:
+                        self.h()
+                def h(self):
+                    with self._cond:
+                        pass
+            """, path=SERV) == []
+
+    def test_positive_inconsistent_lock_order(self):
+        vs = lint("""
+            import threading
+            class T:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL202"]
+        assert "inconsistent lock order" in vs[0].message
+
+    def test_negative_consistent_two_lock_order(self):
+        assert rule_ids("""
+            import threading
+            class T:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def g(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """, path=SERV) == []
+
+    def test_positive_held_on_entry_method_called_without_lock(self):
+        vs = lint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                # guarded-by: _lock
+                def _mutate_locked(self):
+                    self._n += 1
+                def bad(self):
+                    self._mutate_locked()
+                def good(self):
+                    with self._lock:
+                        self._mutate_locked()
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL202"]
+        assert "held on entry" in vs[0].message
+
+
+# ===========================================================================
+# GL203 future-settlement
+# ===========================================================================
+class TestFutureSettlement:
+    def test_positive_popped_request_never_settled(self):
+        # the settle-every-path class: a backlog sweep that pops
+        # requests but resolves nothing strands every waiter
+        vs = lint("""
+            import threading
+            from collections import deque
+            class B:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._q = deque()
+                def _cancel_backlog(self):
+                    rows = 0
+                    while True:
+                        with self._cond:
+                            if not self._q:
+                                return rows
+                            req = self._q.popleft()
+                        rows += req.n_rows
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL203"]
+        assert "never settled" in vs[0].message
+
+    def test_negative_cancel_counts_as_settlement(self):
+        assert rule_ids("""
+            import threading
+            from collections import deque
+            class B:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._q = deque()
+                def _cancel_backlog(self):
+                    rows = 0
+                    while True:
+                        with self._cond:
+                            if not self._q:
+                                return rows
+                            req = self._q.popleft()
+                        if req.future.cancel():
+                            rows += req.n_rows
+            """, path=SERV) == []
+
+    def test_positive_bare_pop_statement_discards(self):
+        vs = lint("""
+            class B:
+                def drain(self, out_q):
+                    out_q.get_nowait()
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL203"]
+        assert "discarded" in vs[0].message
+
+    def test_negative_handoff_and_settle_paths(self):
+        # append to a batch (hand-off), settle_future(...), unpack then
+        # invoke (the AsyncSnapshotWriter shape), subexpression pops
+        assert rule_ids("""
+            from collections import deque
+            def collect(q, dispatch_fn):
+                batch = []
+                first = q.popleft()
+                batch.append(first)
+                dispatch_fn(batch)
+            def on_done(inflight, token):
+                entry = inflight.pop(token, None)
+                route, inner = entry
+                settle_future(inner, result=1)
+            def writer_loop(job_q):
+                item = job_q.get()
+                job, context = item
+                job()
+            def drain_results(inflight):
+                return [inflight.pop(0).result() for _ in range(3)]
+            """, path=SERV) == []
+
+    def test_negative_dict_get_lookup_is_not_a_pop(self):
+        assert rule_ids("""
+            def route(inflight, token):
+                entry = inflight.get(token)
+                return entry
+            """, path=SERV) == []
+
+
+# ===========================================================================
+# GL204 thread-lifecycle
+# ===========================================================================
+class TestThreadLifecycle:
+    def test_positive_nondaemon_never_joined(self):
+        vs = lint("""
+            import threading
+            def spawn(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL204"]
+        assert "neither daemon" in vs[0].message
+
+    def test_positive_unbound_thread_discarded(self):
+        vs = lint("""
+            import threading
+            def fire_and_forget(fn):
+                threading.Thread(target=fn, daemon=True).start()
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL204"]
+        assert "never bound" in vs[0].message
+
+    def test_negative_daemon_bound_and_joined_variants(self):
+        assert rule_ids("""
+            import threading
+            class S:
+                def start(self):
+                    self._thread = threading.Thread(target=self._run,
+                                                    daemon=True)
+                    self._thread.start()
+                def stop(self):
+                    self._thread.join(timeout=2.0)
+                def _run(self):
+                    pass
+            def run_once(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+            """, path=SERV) == []
+
+    def test_positive_join_in_another_class_does_not_exonerate(self):
+        # review regression: the joined/daemon search is scoped to the
+        # binding's own class — a same-named `self._thread` joined in
+        # a DIFFERENT class must not mask this class's orphan
+        vs = lint("""
+            import threading
+            class Joins:
+                def start(self):
+                    self._thread = threading.Thread(target=self._run)
+                    self._thread.start()
+                def stop(self):
+                    self._thread.join()
+                def _run(self):
+                    pass
+            class Orphans:
+                def start(self):
+                    self._thread = threading.Thread(target=self._run)
+                    self._thread.start()
+                def _run(self):
+                    pass
+            """, path=SERV)
+        # exactly one finding, anchored inside the non-joining class
+        assert [v.rule for v in vs] == ["GL204"]
+        assert vs[0].line > 10
+
+    def test_negative_listcomp_bound_threads_joined_via_loop(self):
+        # the bench/autotune shape: a pool of workers joined through
+        # iteration over the container binding
+        assert rule_ids("""
+            import threading
+            def sweep(fns):
+                workers = [threading.Thread(target=f) for f in fns]
+                for t in workers:
+                    t.start()
+                for t in workers:
+                    t.join()
+            """, path=SERV) == []
+
+
+# ===========================================================================
+# GL205 wait-predicate
+# ===========================================================================
+class TestWaitPredicate:
+    def test_positive_wait_under_if(self):
+        vs = lint("""
+            import threading
+            class P:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+                def bad(self):
+                    with self._cond:
+                        if not self.ready:
+                            self._cond.wait()
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL205"]
+        assert "while" in vs[0].message
+
+    def test_negative_wait_in_while_loop(self):
+        assert rule_ids("""
+            import threading
+            class P:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+                def good(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait()
+                def supervise(self):
+                    while True:
+                        with self._cond:
+                            self._cond.wait(timeout=1.0)
+            """, path=SERV) == []
+
+    def test_negative_event_wait_is_not_a_condition(self):
+        assert rule_ids("""
+            import threading
+            def waiter(stop_event):
+                stop_event.wait(0.5)
+            """, path=SERV) == []
+
+
+# ===========================================================================
+# GL206 blocking-under-lock
+# ===========================================================================
+class TestBlockingUnderLock:
+    def test_positive_sleep_result_fsync_under_lock(self):
+        vs = lint("""
+            import os
+            import threading
+            import time
+            class D:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def bad(self, fut, fd):
+                    with self._lock:
+                        time.sleep(0.1)
+                        out = fut.result()
+                        os.fsync(fd)
+                    return out
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL206"] * 3
+
+    def test_positive_wait_on_foreign_condition_under_lock(self):
+        vs = lint("""
+            import threading
+            class D:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._c = threading.Condition()
+                def cross(self):
+                    with self._a:
+                        with self._c:
+                            pass
+                def bad(self):
+                    with self._a:
+                        while True:
+                            self._c.wait()
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL206"]
+        assert "waiting on `self._c`" in vs[0].message
+
+    def test_negative_wait_on_held_condition_releases_it(self):
+        assert rule_ids("""
+            import threading
+            class D:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.ready = False
+                def ok(self):
+                    with self._cond:
+                        while not self.ready:
+                            self._cond.wait()
+            """, path=SERV) == []
+
+    def test_negative_blocking_outside_lock_and_re_compile(self):
+        assert rule_ids("""
+            import re
+            import threading
+            import time
+            class D:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def ok(self, fut):
+                    with self._lock:
+                        pat = re.compile("x+")
+                    time.sleep(0.1)
+                    return fut.result(), pat
+            """, path=SERV) == []
+
+    def test_positive_xla_compile_under_lock(self):
+        vs = lint("""
+            import threading
+            class S:
+                def __init__(self, jit):
+                    self._warm_lock = threading.Lock()
+                    self._jit = jit
+                    self._compiled = {}
+                def warmup(self, params, spec):
+                    with self._warm_lock:
+                        self._compiled[1] = self._jit.lower(
+                            params, spec).compile()
+            """, path=SERV)
+        assert [v.rule for v in vs] == ["GL206"]
+        assert "XLA compile" in vs[0].message
+
+
+# ===========================================================================
+# GL2xx suppressions + reverted-hazard regression fixtures
+# ===========================================================================
+class TestGL2Suppressions:
+    def test_trailing_suppression_scopes_to_line(self):
+        src = ("import threading\n"
+               "class B:\n"
+               "    def __init__(self):\n"
+               "        self._cond = threading.Condition()\n"
+               "        self._q = []   # guarded-by: _cond\n"
+               "    def racy_hint(self):\n"
+               "        return len(self._q)  # graftlint: disable=GL201\n"
+               "    def still_bad(self):\n"
+               "        return len(self._q)\n")
+        vs = lint_source(src, path=SERV)
+        assert [(v.rule, v.line) for v in vs] == [("GL201", 9)]
+
+    def test_rule_name_alias_suppresses(self):
+        src = ("import threading\n"
+               "def fire(fn):\n"
+               "    # supervised externally"
+               "  graftlint: disable=thread-lifecycle\n"
+               "    threading.Thread(target=fn, daemon=True).start()\n")
+        assert lint_source(src, path=SERV) == []
+
+
+class TestRevertedHazards:
+    """The acceptance gate: real concurrency-bug classes from the PR
+    5/10/11 review rounds, re-created by reverting their fixes in
+    fixture form, must be caught by the family."""
+
+    def test_resolve_lock_retake_revert_is_caught(self):
+        # PR 5 review: ModelRegistry._resolve's KeyError path re-took
+        # the non-reentrant registry lock through a helper — deadlock.
+        # The fix documented the caller-must-hold contract; reverting
+        # it (helper re-acquires) must fire GL202.
+        src = """
+            import threading
+            class ModelRegistry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._services = {}
+                    self._latest = {}
+                # guarded-by: _lock
+                def _resolve(self, name, version):
+                    if name not in self._latest:
+                        raise KeyError(
+                            f"no model; have {self.list_models()}")
+                    return (name, self._latest[name])
+                def list_models(self):
+                    with self._lock:
+                        return sorted(self._services)
+                def get(self, name, version=None):
+                    with self._lock:
+                        return self._services[
+                            self._resolve(name, version)]
+            """
+        vs = lint(src, path="bigdl_tpu/serving/registry_reverted.py")
+        assert [v.rule for v in vs] == ["GL202"]
+        assert "deadlock" in vs[0].message
+
+    def test_settle_every_path_revert_is_caught(self):
+        # PR 5/10 invariant "accepted requests ALWAYS resolve": the
+        # batcher's cancel path settles every popped future.  Reverting
+        # the settle (pop-and-count only) must fire GL203.
+        src = """
+            import threading
+            from collections import deque
+            class RequestBatcher:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._q = deque()
+                    self.cancelled_rows = 0
+                def _cancel_backlog(self):
+                    rows = 0
+                    while True:
+                        with self._cond:
+                            if not self._q:
+                                self.cancelled_rows += rows
+                                return rows
+                            req = self._q.popleft()
+                        rows += req.n_rows
+            """
+        vs = lint(src, path="bigdl_tpu/serving/batcher_reverted.py")
+        assert [v.rule for v in vs] == ["GL203"]
+
+    def test_fixed_shapes_stay_silent(self):
+        # the shipped fixes of both classes lint clean — the rules
+        # gate the regression, not the idiom
+        assert rule_ids("""
+            import threading
+            from collections import deque
+            class RequestBatcher:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._q = deque()
+                    self.cancelled_rows = 0
+                def _cancel_backlog(self):
+                    rows = 0
+                    while True:
+                        with self._cond:
+                            if not self._q:
+                                self.cancelled_rows += rows
+                                return rows
+                            req = self._q.popleft()
+                        if req.future.cancel():
+                            rows += req.n_rows
+            """, path="bigdl_tpu/serving/batcher_fixed.py") == []
+
+
+# ===========================================================================
 # rule catalog invariants
 # ===========================================================================
 class TestCatalog:
     def test_every_rule_registered_with_metadata(self):
         rules = all_rules()
-        assert len(rules) >= 7
+        assert len(rules) >= 13
         ids = [r.id for r in rules]
         assert ids == sorted(ids)
         for r in rules:
@@ -640,6 +1318,158 @@ class TestCLI:
         assert "GL000" in r.stdout
 
 
+class TestSarifOutput:
+    def test_sarif_schema_and_location(self, tmp_path):
+        bad = tmp_path / "bigdl_tpu"
+        bad.mkdir()
+        (bad / "seeded.py").write_text(SEEDED)
+        r = run_cli("--format", "sarif", str(bad))
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in doc["$schema"]
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "graftlint"
+        rule_ids_in_driver = [ru["id"] for ru in driver["rules"]]
+        for rule in all_rules():
+            assert rule.id in rule_ids_in_driver
+        (res,) = run["results"]
+        assert res["ruleId"] == "GL105"
+        assert res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("seeded.py")
+        assert loc["region"]["startLine"] == 4
+        assert loc["region"]["startColumn"] >= 1
+        # results reference the driver rules by index
+        assert rule_ids_in_driver[res["ruleIndex"]] == "GL105"
+
+    def test_sarif_clean_run_has_empty_results(self, tmp_path):
+        f = tmp_path / "clean.py"
+        f.write_text("x = 1\n")
+        r = run_cli("--format", "sarif", str(f))
+        assert r.returncode == 0
+        doc = json.loads(r.stdout)
+        assert doc["runs"][0]["results"] == []
+
+    def test_json_flag_still_emits_graftlint_schema(self, tmp_path):
+        # --json stays the graftlint schema (alias of --format json);
+        # mixing it with a different --format is a usage error
+        f = tmp_path / "clean.py"
+        f.write_text("x = 1\n")
+        r = run_cli("--json", "--format", "sarif", str(f))
+        assert r.returncode == 2
+
+
+class TestStatsCLI:
+    SRC = ("import numpy as np\n"
+           "A = np.zeros(3, dtype=np.float64)"
+           "  # precomputed simplex; graftlint: disable=GL104\n"
+           "B = np.zeros(3, dtype=np.float64)\n"
+           "C = np.random.rand(3)\n")
+
+    def test_stats_counts_findings_and_suppressions(self, tmp_path):
+        d = tmp_path / "bigdl_tpu"
+        d.mkdir()
+        (d / "mod.py").write_text(self.SRC)
+        r = run_cli("--stats", str(d))
+        assert r.returncode == 0  # stats is a dashboard, not a gate
+        lines = {ln.split()[0]: ln for ln in r.stdout.splitlines()
+                 if ln.startswith("GL")}
+        # GL104: one live finding, one suppressed; GL105: one finding
+        assert lines["GL104"].split()[-2:] == ["1", "1"]
+        assert lines["GL105"].split()[-2:] == ["1", "0"]
+        # every registered rule has a row (zero-debt rows included)
+        for rule in all_rules():
+            assert rule.id in lines
+
+    def test_stats_json(self, tmp_path):
+        d = tmp_path / "bigdl_tpu"
+        d.mkdir()
+        (d / "mod.py").write_text(self.SRC)
+        r = run_cli("--stats", "--json", str(d))
+        doc = json.loads(r.stdout)
+        assert doc["files_scanned"] == 1
+        assert doc["rules"]["GL104"] == {
+            "name": "float64-promotion", "findings": 1, "suppressed": 1}
+
+    def test_stats_rejects_unsupported_flag_combos(self, tmp_path):
+        # review regression: --stats must refuse flags it cannot
+        # honor instead of silently reporting whole-tree numbers
+        f = tmp_path / "clean.py"
+        f.write_text("x = 1\n")
+        assert run_cli("--stats", "--changed-only",
+                       str(f)).returncode == 2
+        assert run_cli("--stats", "--format", "sarif",
+                       str(f)).returncode == 2
+
+    def test_select_prefix_runs_a_family(self, tmp_path):
+        f = tmp_path / "bigdl_tpu_mod.py"
+        f.write_text("import threading\n"
+                     "def fire(fn):\n"
+                     "    threading.Thread(target=fn).start()\n"
+                     "x = __import__('numpy').random.rand(3)\n")
+        r = run_cli("--json", "--select", "GL2", str(f))
+        doc = json.loads(r.stdout)
+        assert {v["rule"] for v in doc["violations"]} == {"GL204"}
+
+
+class TestChangedOnlyImportClosure:
+    def test_importers_of_changed_modules_are_relinted(self, tmp_path):
+        from tools.graftlint import core
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        a = pkg / "locks.py"
+        a.write_text("import threading\nLOCK = threading.Lock()\n")
+        b = pkg / "user_abs.py"
+        b.write_text("from pkg.locks import LOCK\n")
+        c = pkg / "user_rel.py"
+        c.write_text("from . import locks\n")
+        d = pkg / "bystander.py"
+        d.write_text("x = 1\n")
+        files = [str(a), str(b), str(c), str(d)]
+        got = core.expand_changed_with_importers(
+            files, [str(a)], root=str(tmp_path))
+        assert got == [str(a), str(b), str(c)]
+
+    def test_plain_import_reaches_ancestor_packages(self, tmp_path):
+        # review regression: `import a.b.c` executes a/__init__ and
+        # a/b/__init__ too, so a changed package __init__ re-lints
+        # importers using the plain-import form as well
+        from tools.graftlint import core
+        pkg = tmp_path / "pkg"
+        sub = pkg / "sub"
+        sub.mkdir(parents=True)
+        init = pkg / "__init__.py"
+        init.write_text("")
+        (sub / "__init__.py").write_text("")
+        leaf = sub / "leaf.py"
+        leaf.write_text("x = 1\n")
+        user = tmp_path / "user.py"
+        user.write_text("import pkg.sub.leaf\n")
+        got = core.expand_changed_with_importers(
+            [str(leaf), str(user)], [str(init)], root=str(tmp_path))
+        assert got == [str(user)]
+
+    def test_no_changes_scans_nothing(self, tmp_path):
+        from tools.graftlint import core
+        f = tmp_path / "m.py"
+        f.write_text("x = 1\n")
+        assert core.expand_changed_with_importers(
+            [str(f)], [], root=str(tmp_path)) == []
+
+    def test_module_name_of(self, tmp_path):
+        from tools.graftlint import core
+        root = str(tmp_path)
+        assert core.module_name_of(
+            str(tmp_path / "a" / "b.py"), root) == "a.b"
+        assert core.module_name_of(
+            str(tmp_path / "a" / "__init__.py"), root) == "a"
+        assert core.module_name_of(
+            str(tmp_path.parent / "outside.py"), root) is None
+
+
 class TestChangedOnly:
     def test_filter_changed_intersects_normalized(self):
         files = ["bigdl_tpu/nn/module.py", "bigdl_tpu/optim/sgd.py"]
@@ -766,6 +1596,50 @@ class TestRealTree:
         assert result.files_scanned == 5
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
+
+    def test_threaded_packages_clean_under_gl2_select(self):
+        """Standalone concurrency gate (ISSUE-13): the threaded
+        serving/resilience/telemetry/checkpoint plane must hold its
+        documented locking contracts under the GL2xx family alone —
+        `# guarded-by:` annotations honored, no non-reentrant
+        re-takes, settle-every-path, thread lifecycle, wait
+        predicates, no blocking under locks.  A violation here is a
+        regression of exactly the bug classes the PR 5/10/11 review
+        rounds kept finding by repro."""
+        result = lint_paths(
+            [os.path.join(REPO, "bigdl_tpu", p)
+             for p in ("serving", "resilience", "telemetry",
+                       "checkpoint")],
+            select=["GL2"])
+        assert result.files_scanned >= 18
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
+    def test_guarded_by_annotations_are_bound(self):
+        """The annotation rollout is real, not cosmetic: the thread
+        model must bind `# guarded-by:` declarations in the core
+        threaded classes (a silently-unparsed annotation would turn
+        GL201 into a no-op)."""
+        import ast as _ast
+
+        from tools.graftlint import threads as _threads
+        expect = {
+            ("bigdl_tpu/serving/batcher.py", "RequestBatcher", "_q"),
+            ("bigdl_tpu/serving/registry.py", "ModelRegistry",
+             "_services"),
+            ("bigdl_tpu/resilience/replica_set.py", "ReplicaSet",
+             "_inflight"),
+            ("bigdl_tpu/resilience/health.py", "ReplicaHealth",
+             "_probe_inflight"),
+            ("bigdl_tpu/telemetry/registry.py", "MetricRegistry",
+             "_metrics"),
+            ("bigdl_tpu/telemetry/tracer.py", "Tracer", "_events"),
+        }
+        for rel, cls, attr in sorted(expect):
+            src = open(os.path.join(REPO, rel)).read()
+            model = _threads.ThreadModel(_ast.parse(src), src, rel)
+            guards = model.guards_for(cls)
+            assert attr in guards, f"{rel}: {cls}.{attr} unbound"
 
     def test_checkpoint_package_lints_clean(self):
         """Same standalone discipline for the checkpoint package: its

@@ -32,10 +32,20 @@ def main(argv=None) -> int:
                          "(default: bigdl_tpu)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (schema version "
-                         f"{core.JSON_SCHEMA_VERSION})")
+                         f"{core.JSON_SCHEMA_VERSION}); alias of "
+                         "--format json")
+    ap.add_argument("--format", default=None,
+                    choices=("human", "json", "sarif"),
+                    help="output format: human (default), json "
+                         "(graftlint schema) or sarif (SARIF 2.1.0 — "
+                         "CI inline PR annotations)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule finding/suppression counts "
+                         "(the suppression-debt dashboard) and exit 0")
     ap.add_argument("--select", default=None,
-                    help="comma-separated rule ids/names to run "
-                         "(default: all)")
+                    help="comma-separated rule ids/names to run; an id "
+                         "prefix selects a family (--select GL2 runs "
+                         "GL201-GL206) (default: all)")
     ap.add_argument("--changed-only", action="store_true",
                     help="lint only files changed vs --base "
                          "(plus untracked)")
@@ -50,6 +60,12 @@ def main(argv=None) -> int:
             print(f"{r.id}  {r.name:24s} [{r.severity}] {r.description}")
         return 0
 
+    fmt = args.format or ("json" if args.json else "human")
+    if args.json and args.format and args.format != "json":
+        print("graftlint: --json conflicts with "
+              f"--format {args.format}", file=sys.stderr)
+        return 2
+
     paths = args.paths or ["bigdl_tpu"]
     for p in paths:
         if not os.path.exists(p):
@@ -57,10 +73,33 @@ def main(argv=None) -> int:
             return 2
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
+    if args.stats:
+        # --stats is a whole-tree dashboard: scoping or reformatting
+        # flags it cannot honor are usage errors, not silent no-ops
+        if args.changed_only:
+            print("graftlint: --stats does not support --changed-only "
+                  "(the debt table is whole-tree)", file=sys.stderr)
+            return 2
+        if fmt == "sarif":
+            print("graftlint: --stats has no SARIF form; use --json",
+                  file=sys.stderr)
+            return 2
+        stats = core.lint_paths_stats(paths, select=select)
+        if fmt == "json":
+            import json
+            print(json.dumps(stats, indent=2, sort_keys=True))
+        else:
+            print(core.stats_to_human(stats))
+        return 0
     result = core.lint_paths(paths, select=select,
                              changed_only=args.changed_only,
                              base=args.base)
-    print(core.to_json(result) if args.json else core.to_human(result))
+    if fmt == "json":
+        print(core.to_json(result))
+    elif fmt == "sarif":
+        print(core.to_sarif(result))
+    else:
+        print(core.to_human(result))
     return result.exit_code
 
 

@@ -26,9 +26,9 @@ import json
 import os
 import re
 import subprocess
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
-from tools.graftlint import tracing
+from tools.graftlint import threads, tracing
 
 SEVERITIES = ("error", "warning")
 
@@ -139,6 +139,13 @@ class Suppressions:
         return bool(self.by_line.get(v.line, set()) & keys)
 
 
+def _selected(rule: "Rule", select: Sequence[str]) -> bool:
+    """``--select`` matching: exact rule id, exact rule name, or an id
+    PREFIX — ``--select GL2`` runs the whole GL2xx concurrency family."""
+    return any(rule.name == s or rule.id.startswith(s)
+               for s in select if s)
+
+
 # --------------------------------------------------------------- file context
 
 class FileContext:
@@ -151,6 +158,7 @@ class FileContext:
         self.lines = source.splitlines()
         self.suppressions = Suppressions(source)
         self.traced = tracing.TracedModel(self.tree, path)
+        self.threads = threads.ThreadModel(self.tree, source, path)
         norm = path.replace(os.sep, "/")
         base = os.path.basename(norm)
         self.is_test = ("/tests/" in norm or norm.startswith("tests/")
@@ -167,22 +175,36 @@ def lint_source(source: str, path: str = "<string>",
                 select: Optional[Sequence[str]] = None,
                 respect_suppressions: bool = True) -> List[Violation]:
     """Lint one source string.  ``select`` restricts to those rule ids."""
+    kept, suppressed = _lint_source_full(source, path, select)
+    if respect_suppressions:
+        return kept
+    out = sorted(kept + suppressed,
+                 key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out
+
+
+def _lint_source_full(source: str, path: str,
+                      select: Optional[Sequence[str]] = None,
+                      ) -> Tuple[List[Violation], List[Violation]]:
+    """(kept, suppressed) violations for one source string — the
+    suppressed list powers ``--stats``' suppression-debt view."""
     try:
         ctx = FileContext(path, source)
     except SyntaxError as e:
         return [Violation("GL000", "syntax-error", "error", path,
                           e.lineno or 1, (e.offset or 0) + 1,
-                          f"file does not parse: {e.msg}")]
-    out: List[Violation] = []
+                          f"file does not parse: {e.msg}")], []
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
     for rule in all_rules():
-        if select and rule.id not in select and rule.name not in select:
+        if select and not _selected(rule, select):
             continue
         for v in rule.check(ctx):
-            if respect_suppressions and ctx.suppressions.is_suppressed(v):
-                continue
-            out.append(v)
-    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
-    return out
+            (suppressed if ctx.suppressions.is_suppressed(v)
+             else kept).append(v)
+    kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    suppressed.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return kept, suppressed
 
 
 def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
@@ -232,6 +254,98 @@ def filter_changed(files: Iterable[str], changed: Iterable[str]) -> List[str]:
     return [f for f in files if os.path.abspath(f) in norm]
 
 
+# ------------------------------------------------- changed-import closure
+
+def module_name_of(path: str, root: str) -> Optional[str]:
+    """Dotted module name of a .py file relative to the import root
+    (``bigdl_tpu/serving/batcher.py`` -> ``bigdl_tpu.serving.batcher``;
+    a package ``__init__.py`` names the package itself)."""
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    if rel.startswith("..") or not rel.endswith(".py"):
+        return None
+    rel = rel[:-3]
+    parts = rel.split(os.sep)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts or not all(p.isidentifier() for p in parts):
+        return None
+    return ".".join(parts)
+
+
+def imported_modules(source: str, pkg: str = "") -> set:
+    """DIRECTLY imported dotted module names in one source file.
+    ``pkg`` is the file's own package (for resolving relative
+    imports).  ``from a.b import c`` contributes ``a.b`` (and ``a.b.c``
+    — the name may be a submodule); ``import a.b`` contributes
+    ``a.b``."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return set()
+    out: set = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                # `import a.b.c` executes a/__init__ and a/b/__init__
+                # on the way down — ancestor packages are imports too
+                parts = a.name.split(".")
+                for k in range(1, len(parts) + 1):
+                    out.add(".".join(parts[:k]))
+        elif isinstance(n, ast.ImportFrom):
+            base = n.module or ""
+            if n.level:
+                # relative import: climb `level` packages from pkg
+                parts = pkg.split(".") if pkg else []
+                parts = parts[:len(parts) - (n.level - 1)] \
+                    if n.level <= len(parts) + 1 else []
+                base = ".".join(parts + ([n.module] if n.module else []))
+            if base:
+                out.add(base)
+                for a in n.names:
+                    out.add(f"{base}.{a.name}")
+    return out
+
+
+def expand_changed_with_importers(files: Sequence[str],
+                                  changed: Iterable[str],
+                                  root: Optional[str] = None) -> List[str]:
+    """The ``--changed-only`` closure: changed files PLUS lint targets
+    that directly import a changed module.  The GL2xx model is
+    cross-attribute within a file (a lock rename in one method
+    re-checks the whole file), and within-repo contracts cross file
+    boundaries through imports — so a change to ``batcher.py`` must
+    re-lint ``service.py`` too.  Direct imports only (the transitive
+    closure is the full run)."""
+    if root is None:
+        try:
+            r = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                               capture_output=True, text=True, check=True)
+            root = r.stdout.strip()
+        except (OSError, subprocess.CalledProcessError):
+            root = os.getcwd()
+    changed_abs = {os.path.abspath(c) for c in changed}
+    changed_mods = {m for c in changed_abs
+                    for m in [module_name_of(c, root)] if m}
+    out: List[str] = []
+    for f in files:
+        fa = os.path.abspath(f)
+        if fa in changed_abs:
+            out.append(f)
+            continue
+        if not changed_mods:
+            continue
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        mod = module_name_of(fa, root) or ""
+        pkg = mod.rsplit(".", 1)[0] if "." in mod else ""
+        if imported_modules(src, pkg) & changed_mods:
+            out.append(f)
+    return out
+
+
 @dataclasses.dataclass
 class LintResult:
     violations: List[Violation]
@@ -252,13 +366,53 @@ def lint_paths(paths: Sequence[str],
                base: str = "HEAD") -> LintResult:
     files = list(iter_python_files(paths))
     if changed_only:
-        files = filter_changed(files, changed_files(base))
+        # changed files PLUS files that directly import a changed
+        # module — a lock/contract change in one module re-lints its
+        # in-repo importers (see expand_changed_with_importers)
+        files = expand_changed_with_importers(files, changed_files(base))
     violations: List[Violation] = []
     for f in files:
         with open(f, "r", encoding="utf-8") as fh:
             violations.extend(lint_source(fh.read(), path=f, select=select))
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return LintResult(violations, len(files))
+
+
+def lint_paths_stats(paths: Sequence[str],
+                     select: Optional[Sequence[str]] = None) -> dict:
+    """Per-rule finding/suppression counts across the tree — the
+    suppression-debt dashboard behind ``--stats``.  Returns
+    ``{"files_scanned": n, "rules": {id: {"name", "findings",
+    "suppressed"}}}`` with a row for every registered rule (zeros
+    included: debt you don't have is part of the dashboard)."""
+    rules = {r.id: {"name": r.name, "findings": 0, "suppressed": 0}
+             for r in all_rules()
+             if not select or _selected(r, select)}
+    files = list(iter_python_files(paths))
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            kept, suppressed = _lint_source_full(fh.read(), path=f,
+                                                 select=select)
+        for v in kept:
+            rules.setdefault(v.rule, {"name": v.name, "findings": 0,
+                                      "suppressed": 0})["findings"] += 1
+        for v in suppressed:
+            rules[v.rule]["suppressed"] += 1
+    return {"files_scanned": len(files), "rules": rules}
+
+
+def stats_to_human(stats: dict) -> str:
+    lines = [f"{'rule':8s}{'name':26s}{'findings':>9s}{'suppressed':>11s}"]
+    tot_f = tot_s = 0
+    for rid in sorted(stats["rules"]):
+        row = stats["rules"][rid]
+        tot_f += row["findings"]
+        tot_s += row["suppressed"]
+        lines.append(f"{rid:8s}{row['name']:26s}{row['findings']:>9d}"
+                     f"{row['suppressed']:>11d}")
+    lines.append(f"{'total':34s}{tot_f:>9d}{tot_s:>11d}")
+    lines.append(f"graftlint --stats: {stats['files_scanned']} file(s)")
+    return "\n".join(lines)
 
 
 # --------------------------------------------------------------------- output
@@ -274,6 +428,59 @@ def to_json(result: LintResult) -> str:
         "counts": counts,
         "violations": [dataclasses.asdict(v) for v in result.violations],
     }, indent=2)
+
+
+SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(result: LintResult) -> str:
+    """SARIF 2.1.0 — the format CI uses to annotate findings inline on
+    PRs.  One run, the full rule catalog as ``tool.driver.rules``
+    (results reference rules by index), one result per violation with
+    a physical location.  Paths are emitted as given (repo-relative
+    when the lint was invoked repo-relative, which is how CI runs it)."""
+    rules = all_rules()
+    index = {r.id: i for i, r in enumerate(rules)}
+    results = []
+    for v in result.violations:
+        res = {
+            "ruleId": v.rule,
+            "level": "error" if v.severity == "error" else "warning",
+            "message": {"text": f"{v.message} ({v.name})"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": v.path.replace(os.sep, "/")},
+                    "region": {"startLine": v.line,
+                               "startColumn": v.col},
+                },
+            }],
+        }
+        if v.rule in index:
+            res["ruleIndex"] = index[v.rule]
+        results.append(res)
+    doc = {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "tools/graftlint/README.md",
+                "rules": [{
+                    "id": r.id,
+                    "name": r.name,
+                    "shortDescription": {"text": r.description},
+                    "defaultConfiguration": {
+                        "level": "error" if r.severity == "error"
+                        else "warning"},
+                } for r in rules],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
 
 
 def to_human(result: LintResult) -> str:

@@ -2,6 +2,7 @@
 import.  Importing this package loads the full default ruleset."""
 
 from tools.graftlint.rules import (  # noqa: F401
+    concurrency,
     dtype_hygiene,
     host_sync,
     purity,

@@ -1,0 +1,756 @@
+"""GL201-GL206: concurrency hazards in the threaded host-side plane.
+
+Every confirmed-by-repro bug in the PR 5/10/11 review rounds was a
+*concurrency* bug in host thread code — leaked probation probes, futures
+stranded RUNNING at replica death, a non-reentrant-lock re-take deadlock
+in ``ModelRegistry._resolve``, orphaned batcher threads pinning
+services.  This family converts those review rounds' contracts into
+checkers, keyed off the shared thread/lock model in
+``tools/graftlint/threads.py`` (the GL2xx analog of ``tracing.py``):
+
+- GL201 unguarded-shared-state — ``# guarded-by:`` /
+  ``# write-guarded-by:`` annotated attributes accessed outside their
+  lock, plus a heuristic for unannotated attributes written both on a
+  spawned thread and off it with no common lock;
+- GL202 lock-retake/ordering — calling a method that acquires
+  non-reentrant lock L while already holding L (the ``_resolve``
+  deadlock class), and inconsistent two-lock acquisition order;
+- GL203 future-settlement — a request/future popped off a queue or
+  inflight map must be settled (``set_result``/``set_exception``/
+  ``cancel``/``settle_future``) or provably handed off — the "accepted
+  requests ALWAYS resolve" invariant;
+- GL204 thread-lifecycle — ``Thread(...)`` objects must be bound (so
+  stop/close can reach them) and daemonized-or-joined — the
+  orphaned-batcher class;
+- GL205 wait-predicate — ``Condition.wait``/``wait_for`` outside a
+  ``while``-predicate loop (missed/spurious wakeups);
+- GL206 blocking-under-lock — sleeps, fsync, HTTP, subprocesses,
+  ``Future.result()``, thread joins, device fetches or XLA compiles
+  while holding a lock.
+
+Scope: all non-test code (``bigdl_tpu/`` including ``dataset/``, and
+``tools/``).  These are host-side rules — the traced-scope model is
+irrelevant here; threaded code must never be traced in the first place.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint import threads
+from tools.graftlint.core import Rule, register
+from tools.graftlint.tracing import dotted, iter_scope, last_seg
+
+
+def _in_scope(ctx) -> bool:
+    return not ctx.is_test
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Base Name of an attribute/subscript chain: ``req.future.cancel``
+    -> ``req``."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+# ============================================================= GL201
+@register
+class UnguardedSharedStateRule(Rule):
+    id = "GL201"
+    name = "unguarded-shared-state"
+    severity = "error"
+    description = ("`# guarded-by:` annotated attribute accessed outside "
+                   "its lock (write-guarded-by: writes only), or an "
+                   "attribute written both on a spawned thread and off "
+                   "it with no common lock")
+
+    # attrs whose unannotated cross-thread writes we tolerate: none —
+    # the heuristic is annotation-free by design
+    def check(self, ctx):
+        if not _in_scope(ctx):
+            return
+        model: threads.ThreadModel = ctx.threads
+        for cls in sorted(model.class_names() | {None},
+                          key=lambda c: (c is None, c or "")):
+            yield from self._check_scope(ctx, model, cls)
+        yield from self._heuristic(ctx, model)
+
+    def _check_scope(self, ctx, model, cls):
+        guards = model.guards_for(cls)
+        if not guards:
+            return
+        for fi in self._funcs_in(model, cls):
+            if fi.name == "__init__" and fi.class_name == cls:
+                continue
+            held = model.held_map(fi.node, fi.class_name)
+            shadowed = (self._local_shadows(fi.node, set(guards))
+                        if cls is None else frozenset())
+            for n in iter_scope(fi.node):
+                name, is_write = self._guarded_access(n, cls)
+                if name is None or name not in guards \
+                        or name in shadowed:
+                    continue
+                lock, mode = guards[name]
+                if mode == threads.GUARD_WRITE and not is_write:
+                    continue
+                if lock in held.get(id(n), frozenset()):
+                    continue
+                what = "write to" if is_write else "read of"
+                yield self.violation(
+                    ctx, n, f"{what} `{self._render(cls, name)}` outside "
+                    f"its declared guard `{lock}` (annotated "
+                    f"{'write-' if mode == threads.GUARD_WRITE else ''}"
+                    f"guarded-by in `{fi.class_name or 'module'}`); take "
+                    "the lock or move the access into a locked method")
+
+    @staticmethod
+    def _render(cls, name):
+        return f"self.{name}" if cls is not None else name
+
+    @staticmethod
+    def _funcs_in(model, cls):
+        if cls is None:
+            # module globals: every function in the file can touch them
+            return list(model.funcs.values())
+        return model.methods_of(cls)
+
+    @staticmethod
+    def _local_shadows(func, names: Set[str]) -> Set[str]:
+        """Guarded-global names that are LOCALS of this function —
+        bound by a parameter or a plain assignment with no ``global``
+        declaration — so every occurrence refers to the shadow, not
+        the guarded module global."""
+        declared_global: Set[str] = set()
+        bound: Set[str] = set()
+        for n in iter_scope(func):
+            if isinstance(n, ast.Global):
+                declared_global.update(n.names)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                bound.add(n.id)
+        a = getattr(func, "args", None)
+        if a is not None:
+            bound.update(x.arg for x in
+                         list(getattr(a, "posonlyargs", [])) + a.args
+                         + a.kwonlyargs)
+            for x in (a.vararg, a.kwarg):
+                if x is not None:
+                    bound.add(x.arg)
+        return (bound - declared_global) & names
+
+    def _guarded_access(self, n, cls) -> Tuple[Optional[str], bool]:
+        """(accessed guarded name, is_write) for one AST node within
+        class scope ``cls`` (None = module globals)."""
+        if cls is not None:
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self":
+                return n.attr, isinstance(n.ctx, (ast.Store, ast.Del))
+            return None, False
+        if isinstance(n, ast.Name):
+            return n.id, isinstance(n.ctx, (ast.Store, ast.Del))
+        return None, False
+
+    # --- heuristic: cross-thread writes without a common lock -----------
+    def _heuristic(self, ctx, model):
+        for cls in sorted(model.class_names()):
+            writes: Dict[str, List[Tuple[ast.AST, frozenset, bool]]] = {}
+            annotated = set(model.guards_for(cls))
+            for fi in model.methods_of(cls):
+                if fi.name == "__init__":
+                    continue
+                held = model.held_map(fi.node, fi.class_name)
+                on_thread = model.on_thread(fi.node)
+                for n in iter_scope(fi.node):
+                    if isinstance(n, ast.Attribute) \
+                            and isinstance(n.ctx, ast.Store) \
+                            and isinstance(n.value, ast.Name) \
+                            and n.value.id == "self" \
+                            and n.attr not in annotated:
+                        writes.setdefault(n.attr, []).append(
+                            (n, held.get(id(n), frozenset()), on_thread))
+            for attr, sites in sorted(writes.items()):
+                thread_sites = [s for s in sites if s[2]]
+                other_sites = [s for s in sites if not s[2]]
+                if not thread_sites or not other_sites:
+                    continue
+                # locks held at EVERY spawned-thread write
+                common = frozenset.intersection(
+                    *[h for (_n, h, _t) in thread_sites])
+                for n, held, _t in other_sites:
+                    if held & common:
+                        continue
+                    yield self.violation(
+                        ctx, n, f"`self.{attr}` is written on a spawned "
+                        f"thread (in `{cls}`) and here with no common "
+                        "lock — guard both writes with one lock and "
+                        "annotate the attribute `# guarded-by: <lock>` "
+                        "(or justify the race with a suppression)")
+
+
+# ===================================================== GL202 retake/order
+@register
+class LockRetakeRule(Rule):
+    id = "GL202"
+    name = "lock-retake"
+    severity = "error"
+    description = ("acquiring (or calling a method that acquires) a "
+                   "non-reentrant lock already held — the "
+                   "ModelRegistry._resolve deadlock class — and "
+                   "inconsistent two-lock acquisition order")
+
+    def check(self, ctx):
+        if not _in_scope(ctx):
+            return
+        model: threads.ThreadModel = ctx.threads
+        # per class: ordered acquisition pairs for the ordering check
+        pairs: Dict[Optional[str],
+                    Dict[Tuple[str, str], ast.AST]] = {}
+        for fi in model.funcs.values():
+            cls = fi.class_name
+            held = model.held_map(fi.node, cls)
+            for n in iter_scope(fi.node):
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    outer = held.get(id(n), frozenset())
+                    for item in n.items:
+                        lk = model.canon_lock(cls, item.context_expr)
+                        if lk is None:
+                            continue
+                        info = model.lock_info(cls, lk)
+                        reentrant = info.reentrant if info else False
+                        family = info.family if info else False
+                        if lk in outer and not reentrant and not family:
+                            yield self.violation(
+                                ctx, n, f"`with {lk}` while `{lk}` is "
+                                "already held and the lock is not "
+                                "reentrant — this deadlocks at runtime")
+                        for o in outer:
+                            if o != lk:
+                                pairs.setdefault(cls, {}).setdefault(
+                                    (o, lk), n)
+                elif isinstance(n, ast.Call):
+                    yield from self._check_call(ctx, model, fi, n,
+                                                held.get(id(n),
+                                                         frozenset()),
+                                                pairs)
+        yield from self._order_cycles(ctx, pairs)
+
+    def _check_call(self, ctx, model, fi, call, outer, pairs):
+        cls = fi.class_name
+        cands = []
+        callee = None
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self" and cls is not None:
+            callee = call.func.attr
+            cands = [c for c in model.by_name.get(callee, [])
+                     if c.class_name == cls]
+        elif isinstance(call.func, ast.Name):
+            callee = call.func.id
+            cands = [c for c in model.by_name.get(callee, [])
+                     if c.class_name is None]
+        for c in cands:
+            # the inverse contract: a held-on-entry (`# guarded-by:` on
+            # the def) method called WITHOUT its lock.  __init__ is
+            # exempt — the object is not shared yet.
+            entry = model.entry_held.get(id(c.node), set())
+            missing = sorted(entry - outer)
+            if missing and fi.name != "__init__":
+                yield self.violation(
+                    ctx, call, f"`{callee}()` declares "
+                    f"{'/'.join(f'`{lk}`' for lk in missing)} held on "
+                    "entry (`# guarded-by:` on its def) but the lock "
+                    "is not held here — take it around the call")
+            if not outer:
+                continue
+            acq = model.acquires(c.node, c.class_name)
+            for lk in sorted(acq):
+                info = model.lock_info(cls, lk)
+                reentrant = info.reentrant if info else False
+                family = info.family if info else False
+                if lk in outer and not reentrant and not family:
+                    yield self.violation(
+                        ctx, call, f"`{callee}()` acquires `{lk}` which "
+                        f"is already held here — a non-reentrant re-take "
+                        "deadlock (the ModelRegistry._resolve class); "
+                        "hoist the call out of the locked region or "
+                        "split a `_locked` variant that the caller's "
+                        "lock covers")
+                else:
+                    for o in outer:
+                        if o != lk:
+                            pairs.setdefault(cls, {}).setdefault((o, lk),
+                                                                 call)
+
+    def _order_cycles(self, ctx, pairs):
+        for cls, ps in sorted(pairs.items(),
+                              key=lambda kv: (kv[0] is None, kv[0] or "")):
+            seen = set()
+            for (a, b), node in sorted(
+                    ps.items(), key=lambda kv: kv[1].lineno):
+                if (b, a) in ps and frozenset((a, b)) not in seen:
+                    seen.add(frozenset((a, b)))
+                    yield self.violation(
+                        ctx, node, f"inconsistent lock order: `{a}` -> "
+                        f"`{b}` here but `{b}` -> `{a}` at line "
+                        f"{ps[(b, a)].lineno} — two threads taking them "
+                        "in opposite order deadlock; pick one order")
+
+
+# ======================================================= GL203 settlement
+_QUEUE_NAME_RE = re.compile(
+    r"(^|_)(q|queue|deque|backlog|inflight|in_flight|pending|waiters?|"
+    r"requests?|futures?|futs?)(_|s$|$)")
+_POP_METHODS = {"popleft", "pop", "get", "get_nowait"}
+_SETTLE_METHODS = {"set_result", "set_exception", "cancel",
+                   "set_running_or_notify_cancel"}
+_SETTLE_FUNCS = {"settle_future", "_settle"}
+
+
+@register
+class FutureSettlementRule(Rule):
+    id = "GL203"
+    name = "future-settlement"
+    severity = "error"
+    description = ("a request/future popped from a queue or inflight "
+                   "map is neither settled (set_result/set_exception/"
+                   "cancel/settle_future) nor handed off — accepted "
+                   "requests must ALWAYS resolve")
+
+    def check(self, ctx):
+        if not _in_scope(ctx):
+            return
+        model: threads.ThreadModel = ctx.threads
+        for fi in model.funcs.values():
+            yield from self._check_func(ctx, fi.node)
+
+    def _is_pop(self, call: ast.Call) -> bool:
+        if not isinstance(call.func, ast.Attribute):
+            return False
+        meth = call.func.attr
+        if meth not in _POP_METHODS:
+            return False
+        recv = call.func.value
+        recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                     else recv.id if isinstance(recv, ast.Name) else None)
+        if recv_name is None \
+                or not _QUEUE_NAME_RE.search(recv_name.lower()):
+            return False
+        if meth == "get":
+            # dict.get(key[, default]) is a lookup, not a removal; a
+            # blocking queue.get() has no positional key (timeout/block
+            # ride as keywords)
+            return not call.args
+        return True
+
+    def _check_func(self, ctx, func):
+        pops: List[Tuple[ast.Call, Set[str]]] = []  # (node, handles)
+        stmts = list(iter_scope(func))
+        parent_expr = {id(n.value): n for n in stmts
+                       if isinstance(n, ast.Expr)}
+        assigns = {id(n.value): n for n in stmts
+                   if isinstance(n, (ast.Assign, ast.AnnAssign))
+                   and n.value is not None}
+        for n in stmts:
+            if isinstance(n, ast.Call) and self._is_pop(n):
+                if id(n) in parent_expr:
+                    # bare statement: popped and dropped on the floor
+                    yield self.violation(
+                        ctx, n, "popped from "
+                        f"`{dotted(n.func) or 'queue'}` and discarded — "
+                        "if the item carries a future it can never "
+                        "resolve; settle it, hand it off, or justify "
+                        "the drain with a suppression")
+                    continue
+                holder = assigns.get(id(n))
+                handles: Set[str] = set()
+                if holder is not None:
+                    targets = (holder.targets
+                               if isinstance(holder, ast.Assign)
+                               else [holder.target])
+                    for t in targets:
+                        handles |= set(self._target_names(t))
+                if handles:
+                    pops.append((n, handles))
+                # a pop consumed as a subexpression
+                # (`inflight.pop(0).result()`) resolves through its
+                # consumer — nothing to track
+        if not pops:
+            return
+        resolved = self._resolved_names(func, stmts)
+        for n, handles in pops:
+            # derived handles: unpacking/iteration extends the set
+            closure = self._derive(handles, stmts)
+            if not (closure & resolved):
+                yield self.violation(
+                    ctx, n, f"`{'/'.join(sorted(handles))}` popped from "
+                    f"`{dotted(n.func) or 'queue'}` is never settled or "
+                    "handed off in this function — every path that "
+                    "takes a request out of a queue must resolve its "
+                    "future (set_result/set_exception/cancel/"
+                    "settle_future) or pass it on")
+
+    @staticmethod
+    def _target_names(t):
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from FutureSettlementRule._target_names(e)
+        elif isinstance(t, ast.Starred):
+            yield from FutureSettlementRule._target_names(t.value)
+
+    def _derive(self, handles: Set[str], stmts) -> Set[str]:
+        """Close handles over unpacking (`a, b = item`) and iteration
+        (`for r in batch:`)."""
+        out = set(handles)
+        changed = True
+        while changed:
+            changed = False
+            for n in stmts:
+                src = None
+                tgt = None
+                if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                    src, tgt = n.value, n.targets[0]
+                elif isinstance(n, ast.For):
+                    src, tgt = n.iter, n.target
+                if src is None:
+                    continue
+                root = _root_name(src)
+                if root in out:
+                    for nm in self._target_names(tgt):
+                        if nm not in out:
+                            out.add(nm)
+                            changed = True
+        return out
+
+    def _resolved_names(self, func, stmts) -> Set[str]:
+        """Names that reach a settlement or hand-off anywhere in the
+        function (order-insensitive: the rule is per-function, not
+        per-path)."""
+        out: Set[str] = set()
+        for n in stmts:
+            if isinstance(n, ast.Call):
+                # settle: req.future.cancel() / fut.set_result(...)
+                if isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in _SETTLE_METHODS:
+                    root = _root_name(n.func.value)
+                    if root:
+                        out.add(root)
+                # settle_future(req.future, ...) and hand-off via any
+                # call argument (dispatch_fn(batch), batch.append(req))
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    root = _root_name(a)
+                    if root:
+                        out.add(root)
+                # hand-off by invocation: job()
+                if isinstance(n.func, ast.Name):
+                    out.add(n.func.id)
+                # receiver of a call keeps its own handle live only for
+                # settles (handled above), not for reads like req.n_rows
+            elif isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and n.value is not None:
+                # only returning/yielding the handle ITSELF is a
+                # hand-off; `return req.n_rows` reads a field and
+                # still drops the request
+                if isinstance(n.value, ast.Name):
+                    out.add(n.value.id)
+                elif isinstance(n.value, (ast.Tuple, ast.List)):
+                    for e in n.value.elts:
+                        if isinstance(e, ast.Name):
+                            out.add(e.id)
+            elif isinstance(n, ast.Assign):
+                # stored into an attribute/container: someone else can
+                # still settle it
+                for t in n.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        root = _root_name(n.value)
+                        if root:
+                            out.add(root)
+        return out
+
+
+# ======================================================= GL204 lifecycle
+@register
+class ThreadLifecycleRule(Rule):
+    id = "GL204"
+    name = "thread-lifecycle"
+    severity = "error"
+    description = ("threading.Thread objects must be bound (so stop/"
+                   "close can reach them) and daemonized or joined — "
+                   "the orphaned-batcher class")
+
+    def check(self, ctx):
+        if not _in_scope(ctx):
+            return
+        model: threads.ThreadModel = ctx.threads
+        for fi in model.funcs.values():
+            yield from self._check_func(ctx, model, fi)
+        # module-level Thread(...) statements (iter_scope stops at
+        # def/class boundaries, so functions are not double-checked)
+        yield from self._check_body(ctx, model, ctx.tree,
+                                    scope_src=ctx.source)
+
+    def _check_func(self, ctx, model, fi):
+        yield from self._check_body(ctx, model, fi.node,
+                                    scope_src=self._scope_source(ctx, fi))
+
+    def _scope_source(self, ctx, fi):
+        """Source text the join/daemon search may scan: the function
+        itself, or — for methods — the ENCLOSING class body (a
+        `self._t` thread may be joined by a sibling stop()/close(),
+        but a same-named binding joined in a DIFFERENT class must not
+        exonerate this one)."""
+        if fi.class_name is not None:
+            cls = self._enclosing_class(ctx, fi)
+            if cls is not None:
+                seg = ast.get_source_segment(ctx.source, cls)
+                if seg:
+                    return seg
+        seg = ast.get_source_segment(ctx.source, fi.node)
+        return seg or ctx.source
+
+    @staticmethod
+    def _enclosing_class(ctx, fi):
+        best = None
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.ClassDef) and n.name == fi.class_name \
+                    and n.lineno <= fi.node.lineno \
+                    <= (getattr(n, "end_lineno", None) or n.lineno):
+                # innermost match wins (nested same-named classes)
+                if best is None or n.lineno >= best.lineno:
+                    best = n
+        return best
+
+    def _check_body(self, ctx, model, scope, scope_src):
+        for n in iter_scope(scope):
+            if not (isinstance(n, ast.Call)
+                    and last_seg(n.func) == "Thread"
+                    and (dotted(n.func) or "").split(".")[-1] == "Thread"):
+                continue
+            # exclude non-threading "Thread" lookalikes when clearly
+            # namespaced elsewhere
+            d = dotted(n.func) or "Thread"
+            if "." in d and not d.startswith("threading."):
+                continue
+            binding = self._binding(scope, n)
+            daemon = any(k.arg == "daemon"
+                         and isinstance(k.value, ast.Constant)
+                         and k.value.value is True
+                         for k in n.keywords)
+            if binding is None:
+                yield self.violation(
+                    ctx, n, "Thread object is never bound — nothing can "
+                    "join or stop it (orphaned-thread hazard); assign "
+                    "it to a field your stop()/close() reaps")
+                continue
+            names = {binding} | self._iter_aliases(scope, binding)
+            joined = any(re.search(
+                re.escape(nm) + r"\s*\.\s*join\s*\(", scope_src)
+                for nm in names)
+            daemon_set = any(re.search(
+                re.escape(nm) + r"\s*\.\s*daemon\s*=\s*True", scope_src)
+                for nm in names)
+            if not (daemon or daemon_set or joined):
+                yield self.violation(
+                    ctx, n, f"thread bound to `{binding}` is neither "
+                    "daemon=True nor ever joined — it outlives shutdown "
+                    "and pins the process; daemonize it AND join it "
+                    "from stop()/close() (the batcher discipline)")
+
+    @staticmethod
+    def _binding(scope, call) -> Optional[str]:
+        """`self._t` / `t` when the Thread() call is the RHS of an
+        assignment — directly or as an element of a list/comprehension
+        RHS (`ts = [Thread(...) for ...]`) — else None."""
+        def names_of(t):
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                return f"self.{t.attr}"
+            if isinstance(t, ast.Name):
+                return t.id
+            return None
+
+        for n in iter_scope(scope):
+            if isinstance(n, ast.Assign):
+                v = n.value
+                container = (
+                    v is call
+                    or (isinstance(v, ast.ListComp) and v.elt is call)
+                    or (isinstance(v, (ast.List, ast.Tuple))
+                        and call in v.elts))
+                if container:
+                    return names_of(n.targets[0])
+            elif isinstance(n, ast.NamedExpr) and n.value is call \
+                    and isinstance(n.target, ast.Name):
+                return n.target.id
+        return None
+
+    @staticmethod
+    def _iter_aliases(scope, binding: str) -> Set[str]:
+        """Loop targets iterating the binding (`for t in threads:`) —
+        a `.join()` on the loop variable joins the container's
+        threads."""
+        out: Set[str] = set()
+        for n in iter_scope(scope):
+            if isinstance(n, ast.For) and _root_name(n.iter) == binding \
+                    and isinstance(n.target, ast.Name):
+                out.add(n.target.id)
+        return out
+
+
+# ==================================================== GL205 wait-predicate
+_COND_NAME_RE = re.compile(r"cond|cv|wake|not_empty|not_full")
+
+
+@register
+class WaitPredicateRule(Rule):
+    id = "GL205"
+    name = "wait-predicate"
+    severity = "error"
+    description = ("Condition.wait()/wait_for() outside a while-"
+                   "predicate loop — wakeups are advisory (spurious or "
+                   "stale); re-check the predicate in a while")
+
+    def check(self, ctx):
+        if not _in_scope(ctx):
+            return
+        model: threads.ThreadModel = ctx.threads
+        for fi in model.funcs.values():
+            cond_keys = model.condition_keys(fi.class_name)
+            for n, in_while in self._walk(fi.node, False):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("wait", "wait_for")):
+                    continue
+                recv = n.func.value
+                key = model.canon_lock(fi.class_name, recv)
+                is_cond = False
+                if key is not None:
+                    info = model.lock_info(fi.class_name, key)
+                    # post-alias info may be the backing Lock; the attr
+                    # itself being declared a Condition is the signal
+                    raw = (recv.attr if isinstance(recv, ast.Attribute)
+                           else recv.id if isinstance(recv, ast.Name)
+                           else None)
+                    raw_info = None
+                    if raw is not None:
+                        raw_info = model.class_locks.get(
+                            fi.class_name or "", {}).get(raw) \
+                            or model.module_locks.get(raw)
+                    is_cond = bool((raw_info and raw_info.condition)
+                                   or (info and info.condition))
+                else:
+                    nm = (recv.attr if isinstance(recv, ast.Attribute)
+                          else recv.id if isinstance(recv, ast.Name)
+                          else "") or ""
+                    is_cond = bool(_COND_NAME_RE.search(nm.lower()))
+                if is_cond and not in_while:
+                    yield self.violation(
+                        ctx, n, "Condition wait outside a `while` "
+                        "predicate loop — a spurious or stale wakeup "
+                        "proceeds on a false predicate; use `while not "
+                        "pred: cond.wait()` (see RequestBatcher."
+                        "_collect)")
+
+    def _walk(self, node, in_while):
+        """Yield (node, lexically-inside-a-while) without entering
+        nested defs."""
+        for child in ast.iter_child_nodes(node):
+            yield child, in_while
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            yield from self._walk(
+                child, in_while or isinstance(child, ast.While))
+
+
+# ================================================ GL206 blocking-under-lock
+_BLOCKING_DOTTED = {
+    "time.sleep", "os.fsync", "urllib.request.urlopen", "urlopen",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "requests.get", "requests.post",
+    "requests.put", "requests.request", "jax.device_get",
+}
+_BLOCKING_METHODS = {"result", "block_until_ready", "compile"}
+_THREADISH_RE = re.compile(r"thread|worker|proc(ess)?$|supervisor")
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    id = "GL206"
+    name = "blocking-under-lock"
+    severity = "error"
+    description = ("blocking call (sleep/fsync/HTTP/subprocess/"
+                   "Future.result/thread join/device fetch/XLA compile) "
+                   "while holding a lock — every other thread needing "
+                   "the lock stalls behind the slow operation")
+
+    def check(self, ctx):
+        if not _in_scope(ctx):
+            return
+        model: threads.ThreadModel = ctx.threads
+        for fi in model.funcs.values():
+            held = model.held_map(fi.node, fi.class_name)
+            for n in iter_scope(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                locks = held.get(id(n), frozenset())
+                if not locks:
+                    continue
+                why = self._blocking(model, fi, n, locks)
+                if why:
+                    yield self.violation(
+                        ctx, n, f"{why} while holding "
+                        f"{'/'.join(f'`{lk}`' for lk in sorted(locks))} "
+                        "— the lock serializes every other thread "
+                        "behind this; move the slow work outside the "
+                        "locked region (collect under the lock, act "
+                        "outside it)")
+
+    def _blocking(self, model, fi, call, locks) -> Optional[str]:
+        d = dotted(call.func)
+        seg = last_seg(call.func)
+        if d in _BLOCKING_DOTTED or (seg == "fsync" and d == seg):
+            return f"`{d}()` blocks"
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        # last_seg is None when the receiver chain contains a call
+        # (`jit.lower(...).compile()`); the method name is what matters
+        seg = call.func.attr
+        recv = call.func.value
+        if seg == "join":
+            # thread join only: known Thread attrs or thread-ish names
+            if isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self":
+                tattrs = model.class_threads.get(fi.class_name or "",
+                                                 set())
+                if recv.attr in tattrs \
+                        or _THREADISH_RE.search(recv.attr.lower()):
+                    return f"`self.{recv.attr}.join()` blocks"
+            elif isinstance(recv, ast.Name) \
+                    and _THREADISH_RE.search(recv.id.lower()):
+                return f"`{recv.id}.join()` blocks"
+            return None
+        if seg in ("wait", "wait_for"):
+            # waiting on a DIFFERENT condition than (one of) the held
+            # locks blocks without releasing them; waiting on the held
+            # condition releases it and is the normal pattern
+            key = model.canon_lock(fi.class_name, recv)
+            if key is not None and key not in locks:
+                return f"waiting on `{key}`"
+            return None
+        if seg in _BLOCKING_METHODS:
+            if seg == "compile" and isinstance(recv, ast.Name) \
+                    and recv.id == "re":
+                return None  # re.compile is instant
+            if seg == "result":
+                return "`.result()` blocks on a future"
+            if seg == "block_until_ready":
+                return "`.block_until_ready()` drains the device queue"
+            return "`.compile()` runs an XLA compile"
+        return None

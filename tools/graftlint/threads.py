@@ -1,0 +1,475 @@
+"""Thread/lock model: which locks exist, what they guard, which code
+runs on which thread, and which locks are held where.
+
+The GL2xx rule family keys off this model the same way GL101-GL103 key
+off ``tracing.TracedModel`` — it encodes the repo's own threading
+conventions rather than generic ones:
+
+- **Lock discovery.**  ``self.X = threading.Lock()`` (and ``RLock`` /
+  ``Condition`` / ``Semaphore``) attributes per class, module-level
+  ``_LOCK = threading.Lock()`` globals, and lock *families*
+  (``self._death_locks = [threading.Lock() ...]``).  Reentrancy is
+  tracked per lock: ``Lock()`` is non-reentrant, ``RLock()`` and a
+  default ``Condition()`` (which wraps an RLock) are reentrant, and
+  ``Condition(self.X)`` ALIASES ``self.X`` — holding the condition is
+  holding the lock (the ``ReplicaSet._wake``/``_lock`` shape).
+- **``# guarded-by:`` annotations** (the lightweight convention the
+  GL201 contract rides on):
+
+  - on an attribute assignment (normally in ``__init__``):
+    ``self._q = deque()  # guarded-by: _cond`` declares every access of
+    ``self._q`` must hold ``self._cond``;
+  - ``# write-guarded-by: _lock`` declares WRITES must hold the lock
+    while reads are deliberately lock-free (single-writer counters,
+    CPython-atomic reference reads — the ``Tracer._dropped`` shape);
+  - on a ``def`` line it declares the lock is held ON ENTRY (the
+    caller-must-hold contract of ``ModelRegistry._resolve`` /
+    ``*_locked`` helpers) — the body is checked as if inside the lock,
+    and GL202 treats a lock acquisition inside it as a re-take.
+
+  Standalone-comment placement follows the suppression convention: a
+  comment line annotates the next statement.  Annotations attach to the
+  statement's FIRST physical line.
+- **Thread entries.**  Functions handed to ``threading.Thread(target=
+  ...)`` / ``Timer``, executor ``submit``/``map`` callbacks, and
+  ``add_done_callback`` hooks, transitively closed over same-file calls
+  (bare names and ``self.method``) — "runs off the constructing thread"
+  is this closure.
+- **Held regions.**  Per function, the set of canonical locks held at
+  every AST node, from lexical ``with self.lock:`` nesting (plus the
+  held-on-entry annotation).  ``lock.acquire()``/``release()`` pairs
+  are NOT modeled (the repo idiom is ``with``; the one
+  ``_profile_lock.acquire(blocking=False)`` try-lock is invisible to
+  the model, documented limitation).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.tracing import FuncInfo, dotted, iter_scope, last_seg
+
+# lock constructors, by reentrancy.  A default Condition() wraps an
+# RLock; Condition(lock) takes the wrapped lock's kind (and aliases it).
+NONREENTRANT_CTORS = {"Lock", "Semaphore", "BoundedSemaphore"}
+REENTRANT_CTORS = {"RLock"}
+CONDITION_CTOR = "Condition"
+LOCK_CTORS = NONREENTRANT_CTORS | REENTRANT_CTORS | {CONDITION_CTOR}
+
+_GUARD_RE = re.compile(
+    r"#.*?\b(write-guarded-by|guarded-by)\s*:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+#: annotation modes
+GUARD_ALL = "all"      # guarded-by: reads and writes need the lock
+GUARD_WRITE = "write"  # write-guarded-by: writes need it, reads are free
+
+
+class LockInfo:
+    """One discovered lock: attribute of a class, or module global."""
+
+    __slots__ = ("name", "reentrant", "alias_of", "family", "condition")
+
+    def __init__(self, name: str, reentrant: bool,
+                 alias_of: Optional[str] = None, family: bool = False,
+                 condition: bool = False):
+        self.name = name
+        self.reentrant = reentrant
+        self.alias_of = alias_of    # peer attr name (Condition(self.X))
+        self.family = family        # list/dict of locks: self.X[i]
+        self.condition = condition  # supports .wait()/.notify()
+
+
+class ThreadModel:
+    """Per-file lock/guard/thread model (see module docstring)."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.path = path
+        self.lines = source.splitlines()
+
+        # class name -> {attr name -> LockInfo}; module-level locks
+        self.class_locks: Dict[str, Dict[str, LockInfo]] = {}
+        self.module_locks: Dict[str, LockInfo] = {}
+        # class name -> attrs assigned threading.Thread(...) somewhere
+        self.class_threads: Dict[str, Set[str]] = {}
+
+        # function index (same shape tracing uses)
+        self.funcs: Dict[int, FuncInfo] = {}
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self._collect(tree, class_name=None, parent=None)
+
+        # annotations
+        # (class name|None, attr/global name) -> (lock key, mode)
+        self.guards: Dict[Tuple[Optional[str], str], Tuple[str, str]] = {}
+        # id(func node) -> set of lock keys held on entry
+        self.entry_held: Dict[int, Set[str]] = {}
+        self._guard_lines = self._annotation_lines()
+        self._discover_locks()
+        self._bind_annotations()
+
+        # thread-entry closure
+        self.thread_entry_ids: Set[int] = set()
+        self._mark_thread_entries()
+        self._propagate_entries()
+
+        self._held_cache: Dict[int, Dict[int, frozenset]] = {}
+
+    # ------------------------------------------------------------ indexing
+    def _collect(self, node, class_name, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._collect(child, class_name=child.name, parent=parent)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(child, child.name, class_name, parent)
+                self.funcs[id(child)] = fi
+                self.by_name.setdefault(child.name, []).append(fi)
+                self._collect(child, class_name=class_name, parent=fi)
+            else:
+                self._collect(child, class_name=class_name, parent=parent)
+
+    # ------------------------------------------------------- lock discovery
+    @staticmethod
+    def _lock_ctor(call: ast.AST) -> Optional[str]:
+        """'Lock'/'RLock'/'Condition'/... when ``call`` constructs a
+        threading lock, else None."""
+        if not isinstance(call, ast.Call):
+            return None
+        seg = last_seg(call.func)
+        if seg not in LOCK_CTORS:
+            return None
+        d = dotted(call.func)
+        # accept bare names (from threading import Lock) and any dotted
+        # path ending in the ctor (threading.Lock, mp.Lock)
+        return seg if d else None
+
+    def _lock_info_from_call(self, call: ast.Call, name: str) -> LockInfo:
+        ctor = self._lock_ctor(call)
+        if ctor == CONDITION_CTOR:
+            # Condition(self.X) aliases X; Condition() wraps an RLock
+            if call.args and isinstance(call.args[0], ast.Attribute) \
+                    and isinstance(call.args[0].value, ast.Name) \
+                    and call.args[0].value.id == "self":
+                return LockInfo(name, reentrant=False,
+                                alias_of=call.args[0].attr, condition=True)
+            return LockInfo(name, reentrant=True, condition=True)
+        return LockInfo(name, reentrant=ctor in REENTRANT_CTORS)
+
+    def _discover_locks(self):
+        # module-level locks
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and self._lock_ctor(node.value):
+                nm = node.targets[0].id
+                self.module_locks[nm] = self._lock_info_from_call(
+                    node.value, nm)
+        # class attribute locks and thread attrs, from any method body
+        for fi in self.funcs.values():
+            if fi.class_name is None:
+                continue
+            for n in iter_scope(fi.node):
+                if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                value = n.value
+                if value is None:
+                    continue
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    cls = fi.class_name
+                    if self._lock_ctor(value):
+                        self.class_locks.setdefault(cls, {})[t.attr] = \
+                            self._lock_info_from_call(value, t.attr)
+                    elif self._is_lock_family(value):
+                        self.class_locks.setdefault(cls, {})[t.attr] = \
+                            LockInfo(t.attr, reentrant=False, family=True)
+                    elif isinstance(value, ast.Call) \
+                            and last_seg(value.func) == "Thread":
+                        self.class_threads.setdefault(cls, set()).add(
+                            t.attr)
+
+    def _is_lock_family(self, value: ast.AST) -> bool:
+        """``[threading.Lock() for ...]`` / ``[Lock(), Lock()]`` — a
+        collection of locks indexed at use sites (``self.X[i]``)."""
+        if isinstance(value, ast.ListComp):
+            return self._lock_ctor(value.elt) is not None
+        if isinstance(value, (ast.List, ast.Tuple)):
+            return bool(value.elts) and all(
+                self._lock_ctor(e) for e in value.elts)
+        return False
+
+    # -------------------------------------------------------- annotations
+    def _annotation_lines(self) -> Dict[int, Tuple[str, str]]:
+        """statement line -> (lock name, mode) from ``# guarded-by:`` /
+        ``# write-guarded-by:`` comments (trailing = that line,
+        standalone comment = next statement line)."""
+        out: Dict[int, Tuple[str, str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _GUARD_RE.search(line)
+            if not m:
+                continue
+            kind, lock = m.groups()
+            mode = GUARD_WRITE if kind.startswith("write") else GUARD_ALL
+            if line.lstrip().startswith("#"):
+                j = i
+                while j < len(self.lines) and (
+                        not self.lines[j].strip()
+                        or self.lines[j].lstrip().startswith("#")):
+                    j += 1
+                out[j + 1] = (lock, mode)
+            else:
+                out[i] = (lock, mode)
+        return out
+
+    def _lock_key(self, lock_name: str,
+                  class_name: Optional[str]) -> Optional[str]:
+        """Canonical key for a lock referenced by bare name in an
+        annotation: ``self.X`` when the class owns it, the global name
+        for module locks."""
+        if class_name is not None:
+            info = self.class_locks.get(class_name, {}).get(lock_name)
+            if info is not None:
+                return self._canon_attr(class_name, lock_name)
+        if lock_name in self.module_locks:
+            return lock_name
+        return None
+
+    def _bind_annotations(self):
+        lines = self._guard_lines
+        if not lines:
+            return
+        # attribute / global guard declarations
+        for fi in self.funcs.values():
+            for n in iter_scope(fi.node):
+                if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if n.lineno not in lines:
+                    continue
+                lock, mode = lines[n.lineno]
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self" \
+                            and fi.class_name is not None:
+                        key = self._lock_key(lock, fi.class_name)
+                        if key:
+                            self.guards[(fi.class_name, t.attr)] = (key,
+                                                                    mode)
+        for n in self.tree.body:
+            if isinstance(n, (ast.Assign, ast.AnnAssign)) \
+                    and n.lineno in lines:
+                lock, mode = lines[n.lineno]
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        key = self._lock_key(lock, None)
+                        if key:
+                            self.guards[(None, t.id)] = (key, mode)
+        # held-on-entry declarations on def lines
+        for fi in self.funcs.values():
+            if fi.node.lineno in lines:
+                lock, _mode = lines[fi.node.lineno]
+                key = self._lock_key(lock, fi.class_name)
+                if key:
+                    self.entry_held.setdefault(id(fi.node), set()).add(key)
+
+    def guards_for(self, class_name: Optional[str]) -> Dict[str,
+                                                            Tuple[str, str]]:
+        """attr/global name -> (lock key, mode) for one class (or the
+        module globals with ``class_name=None``)."""
+        return {attr: g for (cls, attr), g in self.guards.items()
+                if cls == class_name}
+
+    # ---------------------------------------------------- canonicalization
+    def _canon_attr(self, class_name: str, attr: str,
+                    seen: Optional[Set[str]] = None) -> str:
+        info = self.class_locks.get(class_name, {}).get(attr)
+        seen = seen or set()
+        if info is not None and info.alias_of and attr not in seen:
+            seen.add(attr)
+            target = info.alias_of
+            if target in self.class_locks.get(class_name, {}):
+                return self._canon_attr(class_name, target, seen)
+        return f"self.{attr}"
+
+    def lock_info(self, class_name: Optional[str],
+                  key: str) -> Optional[LockInfo]:
+        """LockInfo for a canonical key (post-alias)."""
+        if key.startswith("self."):
+            attr = key[5:].rstrip("[*]")
+            return self.class_locks.get(class_name or "", {}).get(attr)
+        return self.module_locks.get(key)
+
+    def canon_lock(self, class_name: Optional[str],
+                   node: ast.AST) -> Optional[str]:
+        """Canonical lock key of an expression, or None when it isn't a
+        known lock: ``self.X`` attrs (aliases resolved), ``self.X[i]``
+        family members (``self.X[*]``), module-global names."""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and class_name is not None:
+            info = self.class_locks.get(class_name, {}).get(node.attr)
+            if info is not None and not info.family:
+                return self._canon_attr(class_name, node.attr)
+            return None
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Attribute) \
+                and isinstance(node.value.value, ast.Name) \
+                and node.value.value.id == "self" \
+                and class_name is not None:
+            info = self.class_locks.get(class_name, {}).get(
+                node.value.attr)
+            if info is not None and info.family:
+                return f"self.{node.value.attr}[*]"
+            return None
+        if isinstance(node, ast.Name) and node.id in self.module_locks:
+            return node.id
+        return None
+
+    def condition_keys(self, class_name: Optional[str]) -> Set[str]:
+        """Canonical keys of Condition-valued attrs/globals reachable
+        from ``class_name`` (pre-alias attr names map to their canonical
+        lock so held-checks line up)."""
+        out: Set[str] = set()
+        for attr, info in self.class_locks.get(class_name or "",
+                                               {}).items():
+            if info.condition:
+                out.add(self._canon_attr(class_name, attr))
+        for nm, info in self.module_locks.items():
+            if info.condition:
+                out.add(nm)
+        return out
+
+    # ------------------------------------------------------- thread entries
+    def _add_entry_target(self, node: ast.AST):
+        if isinstance(node, ast.Name):
+            for fi in self.by_name.get(node.id, []):
+                self.thread_entry_ids.add(id(fi.node))
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            for fi in self.by_name.get(node.attr, []):
+                if fi.class_name is not None:
+                    self.thread_entry_ids.add(id(fi.node))
+
+    def _mark_thread_entries(self):
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            seg = last_seg(call.func)
+            if seg in ("Thread", "Timer"):
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        self._add_entry_target(kw.value)
+                if seg == "Timer" and len(call.args) >= 2:
+                    self._add_entry_target(call.args[1])
+            elif seg in ("submit", "map") \
+                    and isinstance(call.func, ast.Attribute):
+                recv = last_seg(call.func.value) or ""
+                if re.search(r"pool|executor|^ex$", recv) and call.args:
+                    self._add_entry_target(call.args[0])
+            elif seg == "add_done_callback" and call.args:
+                self._add_entry_target(call.args[0])
+
+    def _propagate_entries(self):
+        """Same-file closure: a function called (bare name /
+        ``self.m``) from a thread entry also runs on that thread."""
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs.values():
+                if id(fi.node) in self.thread_entry_ids:
+                    continue
+                if fi.parent and id(fi.parent.node) in self.thread_entry_ids:
+                    self.thread_entry_ids.add(id(fi.node))
+                    changed = True
+            for fi in list(self.funcs.values()):
+                if id(fi.node) not in self.thread_entry_ids:
+                    continue
+                for n in iter_scope(fi.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    cands: List[FuncInfo] = []
+                    if isinstance(n.func, ast.Name):
+                        cands = self.by_name.get(n.func.id, [])
+                    elif isinstance(n.func, ast.Attribute) \
+                            and isinstance(n.func.value, ast.Name) \
+                            and n.func.value.id == "self":
+                        cands = [c for c in
+                                 self.by_name.get(n.func.attr, [])
+                                 if c.class_name == fi.class_name]
+                    for c in cands:
+                        if id(c.node) not in self.thread_entry_ids:
+                            self.thread_entry_ids.add(id(c.node))
+                            changed = True
+
+    def on_thread(self, func: ast.AST) -> bool:
+        return id(func) in self.thread_entry_ids
+
+    # --------------------------------------------------------- held regions
+    def held_map(self, func: ast.AST,
+                 class_name: Optional[str]) -> Dict[int, frozenset]:
+        """id(node) -> frozenset of canonical lock keys held there, from
+        lexical ``with`` nesting plus the held-on-entry annotation.
+        Nested function/class definitions are NOT entered (their bodies
+        run later, under whatever locks their caller holds)."""
+        if id(func) in self._held_cache:
+            return self._held_cache[id(func)]
+        out: Dict[int, frozenset] = {}
+        entry = frozenset(self.entry_held.get(id(func), set()))
+
+        def visit(node, held):
+            out[id(node)] = held
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = set()
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    if item.optional_vars is not None:
+                        visit(item.optional_vars, held)
+                    lk = self.canon_lock(class_name, item.context_expr)
+                    if lk is not None:
+                        acquired.add(lk)
+                inner = held | frozenset(acquired)
+                for b in node.body:
+                    visit(b, inner)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in getattr(func, "body", []):
+            visit(stmt, entry)
+        self._held_cache[id(func)] = out
+        return out
+
+    def acquires(self, func: ast.AST,
+                 class_name: Optional[str]) -> Set[str]:
+        """Canonical locks this function acquires via ``with`` anywhere
+        in its own body (nested defs excluded)."""
+        out: Set[str] = set()
+        for n in iter_scope(func):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    lk = self.canon_lock(class_name, item.context_expr)
+                    if lk is not None:
+                        out.add(lk)
+        return out
+
+    def methods_of(self, class_name: str) -> List[FuncInfo]:
+        return [fi for fi in self.funcs.values()
+                if fi.class_name == class_name]
+
+    def class_names(self) -> Set[str]:
+        return {fi.class_name for fi in self.funcs.values()
+                if fi.class_name is not None}
