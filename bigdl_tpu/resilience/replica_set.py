@@ -126,6 +126,20 @@ class ReplicaSet:
     - ``request_tracing``: mint a :class:`~bigdl_tpu.telemetry.
       RequestContext` per submit (None = ``Config.request_tracing``);
       contexts carry the per-request hop history.
+    - ``priority_fn``: QoS preemption hook handed to every replica's
+      batcher (see :class:`InferenceService`); the frontend's
+      :class:`~bigdl_tpu.frontend.QosAdmission` supplies it so
+      latency-class tenants preempt batch backlog per replica queue.
+
+    **Elastic replica count** (``set_replica_count``): replicas live in
+    index-stable SLOTS.  Growing warms a new replica OFF the routing
+    path (AOT bucket compiles finish before the slot is admitted);
+    shrinking retires the highest active slot through the quarantine
+    discipline — the retired slot gets zero new traffic while its
+    accepted backlog drains to completion, then its executables and
+    params are released.  Retired slots keep their index (in-flight
+    bookkeeping, health ledgers and fault targeting stay stable) and
+    are reused by the next grow.
     """
 
     _SUPERVISOR_POLL_S = 0.02  # liveness/deadline sweep while inflight
@@ -143,7 +157,8 @@ class ReplicaSet:
                  fault_injector: Optional[FaultInjector] = None,
                  registry: Optional[MetricRegistry] = None,
                  tracer=None, start: bool = True, flight=None,
-                 request_tracing: Optional[bool] = None):
+                 request_tracing: Optional[bool] = None,
+                 priority_fn=None):
         import jax
 
         from bigdl_tpu.telemetry import admin as _admin
@@ -188,38 +203,44 @@ class ReplicaSet:
             params, state = model._params, model._state
         state = state if state is not None else {}
 
-        policy = health or HealthPolicy()
+        # construction materials retained for set_replica_count grow:
+        # a later replica must be built EXACTLY like the originals
+        # (same params source, same devices round-robin, same policy)
+        self._model = model
+        self._base_params = params
+        self._base_state = state
+        self._devices = list(devices)
+        self._policy = policy = health or HealthPolicy()
+        self._input_spec = input_spec
+        self._workload = workload
+        self._started = bool(start)
+        self._priority_fn = priority_fn
+        self._service_kw = dict(
+            max_batch_size=max_batch_size,
+            batch_timeout_ms=batch_timeout_ms,
+            queue_capacity=queue_capacity, buckets=buckets)
         self._replicas: List[InferenceService] = []
         self._health: List[ReplicaHealth] = []
         for i in range(int(n_replicas)):
-            dev = devices[i % len(devices)]
-            # committed per-device placement: the replica's jit follows
-            # its params' device, so replica i's dispatches run on chip
-            # i%D — the replica-per-chip routing of ROADMAP 1a
-            p_i = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, dev), params)
-            s_i = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, dev), state)
-            svc = InferenceService(
-                model, p_i, s_i, input_spec=input_spec,
-                max_batch_size=max_batch_size,
-                batch_timeout_ms=batch_timeout_ms,
-                queue_capacity=queue_capacity, buckets=buckets,
-                workload=workload, name=f"{name}/r{i}",
-                start=start, fault_injector=self._faults,
-                tracer=self.tracer,
-                request_tracing=self._request_tracing)
-            svc._fault_replica = i
+            svc, h = self._build_replica(i, input_spec)
             self._replicas.append(svc)
-            self._health.append(ReplicaHealth(
-                i, policy=policy, registry=self.registry,
-                recorder=self._flight))
+            self._health.append(h)
+            if i == 0:
+                # freeze the RESOLVED knobs off replica 0 so replicas
+                # grown later match the originals even if config/env
+                # defaults drift between now and then
+                self._service_kw = dict(
+                    max_batch_size=svc.max_batch_size,
+                    batch_timeout_ms=svc.batch_timeout_ms,
+                    queue_capacity=svc.queue_capacity,
+                    buckets=svc.buckets)
 
         # counters created eagerly so a zero-event run still snapshots
         # the full schema
         for c in ("failovers", "sheds", "quarantines",
                   "readmissions", "probes", "degradations",
-                  "deadline_timeouts", "replica_deaths", "revivals"):
+                  "deadline_timeouts", "replica_deaths", "revivals",
+                  "replicas_added", "replicas_retired"):
             self.registry.counter(f"resilience/{c}")
 
         # admin plane: config-driven start + source registration — the
@@ -245,6 +266,15 @@ class ReplicaSet:
         # double-revive would double-count the death in the metrics
         self._death_locks = [threading.Lock()
                              for _ in range(len(self._replicas))]
+        # retired slots (orderly scale-down, NOT deaths): excluded from
+        # routing and from the supervisor's death detection while their
+        # backlog drains.  Replaced wholesale (copy-on-write frozenset)
+        # so the lock-free readers on the routing path always see a
+        # consistent set; write-guarded-by: _lock
+        self._retired: frozenset = frozenset()
+        # serializes set_replica_count operations (autoscaler vs manual
+        # scaling); NEVER taken on a request path
+        self._scale_lock = threading.Lock()
         # token -> (route, ix, inner, probe); guarded-by: _lock
         self._inflight: dict = {}
         self._token = itertools.count()
@@ -254,6 +284,34 @@ class ReplicaSet:
         # write-guarded-by: _lock
         self._supervisor: Optional[threading.Thread] = None
         self._wake = threading.Condition(self._lock)
+
+    # ---------------------------------------------------- replica build
+    def _build_replica(self, ix: int, input_spec):
+        """Construct replica ``ix``: params/state committed onto device
+        ``ix % D`` (the replica's jit follows its params' device, so
+        its dispatches run on that chip — the replica-per-chip routing
+        of ROADMAP 1a) behind a fresh :class:`InferenceService` and a
+        fresh health ledger.  With an ``input_spec`` the AOT bucket
+        warmup happens HERE, before the caller admits the slot to
+        routing — a grown replica never serves a compile stall."""
+        import jax
+        dev = self._devices[ix % len(self._devices)]
+        p_i = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, dev), self._base_params)
+        s_i = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, dev), self._base_state)
+        svc = InferenceService(
+            self._model, p_i, s_i, input_spec=input_spec,
+            workload=self._workload, name=f"{self.name}/r{ix}",
+            start=self._started, fault_injector=self._faults,
+            tracer=self.tracer,
+            request_tracing=self._request_tracing,
+            priority_fn=self._priority_fn, **self._service_kw)
+        svc._fault_replica = ix
+        health = ReplicaHealth(ix, policy=self._policy,
+                               registry=self.registry,
+                               recorder=self._flight)
+        return svc, health
 
     # ------------------------------------------------------------ events
     def _instant(self, event: str, **args) -> None:
@@ -287,8 +345,15 @@ class ReplicaSet:
             if i in route.tried:
                 continue
             if not svc.alive:
-                self._on_replica_dead(i)
+                # alive read BEFORE the retired check: retirement marks
+                # the slot retired first, THEN stops the service, so a
+                # reader seeing alive=False is guaranteed a current
+                # retired verdict (an orderly drain is not a death)
+                if i not in self._retired:
+                    self._on_replica_dead(i)
                 continue
+            if i in self._retired:
+                continue  # retiring: backlog drains, no new routes
             eligible.append((i, svc))
         for i, svc in eligible:
             if self._health[i].state == QUARANTINED:
@@ -503,6 +568,12 @@ class ReplicaSet:
                 if inner.done():
                     continue
                 if not self._replicas[ix].alive:
+                    if ix in self._retired:
+                        # orderly retirement mid-drain (alive read
+                        # before retired — see _pick): the stop() in
+                        # _retire_replica resolves this backlog, and
+                        # sweeps any remainder itself on timeout
+                        continue
                     dead.add(ix)
                     _settle(inner, exc=ReplicaDeadError(
                         f"replica {ix} of {self.name!r} died with this "
@@ -550,8 +621,8 @@ class ReplicaSet:
         svc = self._replicas[ix]
         stranded: list = []
         with self._death_locks[ix]:
-            if svc.alive or self._stopped:
-                return  # someone else already revived it (or shutdown)
+            if svc.alive or self._stopped or ix in self._retired:
+                return  # revived already / shutdown / orderly retirement
             self.registry.counter("resilience/replica_deaths").inc()
             self._health[ix].mark_dead()
             self._instant("replica_death", replica=ix)
@@ -571,15 +642,10 @@ class ReplicaSet:
         # settle OUTSIDE the death lock: each settle runs _on_done →
         # failover → _pick on this thread, which may legally re-enter
         # this handler for another replica
-        for route, inner in stranded:
-            if not inner.done():
-                if _settle(inner, exc=ReplicaDeadError(
-                        f"replica {ix} of {self.name!r} died with this "
-                        f"request in flight")):
-                    trace_id = (route.ctx.trace_id
-                                if route.ctx is not None else None)
-                    self._flight_event("stranded_failover",
-                                       trace_id=trace_id, replica=ix)
+        self._sweep_stranded(
+            ix, f"replica {ix} of {self.name!r} died with this "
+                f"request in flight", reason="death",
+            stranded=stranded)
 
     # --------------------------------------------------------------- api
     def submit(self, x, *, timeout: Optional[float] = None,
@@ -632,7 +698,25 @@ class ReplicaSet:
 
     @property
     def n_replicas(self) -> int:
+        """ACTIVE replica count (retired slots excluded)."""
+        return len(self._replicas) - len(self._retired)
+
+    @property
+    def total_slots(self) -> int:
+        """Slot count including retired ones (index-stable)."""
         return len(self._replicas)
+
+    def active_indices(self) -> List[int]:
+        retired = self._retired
+        return [i for i in range(len(self._replicas))
+                if i not in retired]
+
+    @property
+    def max_batch_size(self) -> int:
+        """The per-replica coalescing cap (resolved off replica 0 at
+        construction and frozen — the wire frontend chunks against
+        this)."""
+        return self._service_kw["max_batch_size"]
 
     def replica(self, ix: int) -> InferenceService:
         return self._replicas[ix]
@@ -640,23 +724,158 @@ class ReplicaSet:
     def health_states(self) -> List[str]:
         return [h.state for h in self._health]
 
+    # ------------------------------------------------------ elasticity
+    def _grow_spec(self):
+        """Per-row input spec a grown replica warms against: the
+        construction-time spec, else the warmed row spec of any live
+        replica (deferred-spec sets that have seen traffic), else None
+        (the new replica warms on its first request)."""
+        if self._input_spec is not None:
+            return self._input_spec
+        for i in self.active_indices():
+            spec = self._replicas[i].row_spec
+            if spec is not None:
+                return spec
+        return None
+
+    def set_replica_count(self, n: int, *,
+                          timeout: Optional[float] = None) -> dict:
+        """Grow or shrink to ``n`` ACTIVE replicas (the autoscaler's
+        actuator; also a manual ops lever).  Serialized — concurrent
+        calls queue behind ``_scale_lock``.
+
+        Growing builds each new replica fully warmed (AOT bucket
+        compiles included) BEFORE admitting its slot to routing, so
+        scale-up never serves a compile stall; retired slots are reused
+        lowest-first.  Shrinking retires the highest active slot
+        through the quarantine discipline: the slot stops receiving new
+        routes immediately, its accepted backlog drains to completion
+        (``timeout`` bounds the wait), and its executables/params are
+        released.  Returns ``{"active", "added", "retired"}``."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"replica count must be >= 1: {n}")
+        if self._stopped:
+            raise ServiceClosed(
+                f"replica set {self.name!r} is stopped")
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        added: List[int] = []
+        retired: List[int] = []
+        with self._scale_lock:
+            while self.n_replicas < n:
+                ix = (min(self._retired) if self._retired
+                      else len(self._replicas))
+                # warm OFF the routing path: nothing below touches
+                # shared state until the slot is installed
+                svc, h = self._build_replica(ix, self._grow_spec())
+                with self._lock:
+                    if ix < len(self._replicas):
+                        # slot reuse: the retired flag (cleared LAST)
+                        # keeps lock-free readers off the slot while
+                        # both cells swap
+                        self._health[ix] = h
+                        self._replicas[ix] = svc
+                    else:
+                        # append order matters for the lock-free
+                        # readers: _replicas is the DISCOVERY list
+                        # (_pick enumerates it, then indexes _health /
+                        # _death_locks), so the side tables must exist
+                        # before the slot becomes discoverable
+                        self._health.append(h)
+                        self._death_locks.append(threading.Lock())
+                        self._replicas.append(svc)
+                    self._retired = self._retired - {ix}
+                self.registry.counter("resilience/replicas_added").inc()
+                self._instant("replica_added", replica=ix)
+                self._flight_event("replica_added", replica=ix)
+                added.append(ix)
+            while self.n_replicas > n:
+                ix = max(self.active_indices())
+                self._retire_replica(ix, deadline)
+                retired.append(ix)
+        return {"active": self.n_replicas, "added": added,
+                "retired": retired}
+
+    def _retire_replica(self, ix: int,
+                        deadline: Optional[float]) -> None:
+        """Orderly scale-down of one slot: mark retired (no new routes
+        — the same exclusion quarantine gets), drain the accepted
+        backlog through the replica's own batcher, then release the
+        executables.  Any request a wedged batcher leaves stranded past
+        the deadline is failed over like a death, so accepted work
+        NEVER dangles."""
+        svc = self._replicas[ix]
+        with self._lock:
+            self._retired = self._retired | frozenset((ix,))
+        self.registry.counter("resilience/replicas_retired").inc()
+        self._instant("replica_retired", replica=ix)
+        self._flight_event("replica_retired", replica=ix)
+        remaining = (max(0.1, deadline - time.monotonic())
+                     if deadline is not None else None)
+        svc.stop(drain=True, timeout=remaining)
+        # normally stop(drain=True) resolved everything and _on_done
+        # already emptied this slot's inflight entries; a wedged
+        # batcher that outlived the join timeout leaves stragglers —
+        # fail them over (settle → _on_done → retry on a live replica)
+        self._sweep_stranded(
+            ix, f"replica {ix} of {self.name!r} retired with this "
+                f"request still in flight", reason="retired")
+        svc.release()
+
+    def _sweep_stranded(self, ix: int, message: str, reason: str,
+                        stranded=None) -> None:
+        """Fail over every in-flight request still pinned to replica
+        ``ix`` — the ONE implementation shared by the death handler and
+        the retirement path (each settle runs _on_done → failover on
+        this thread).  The death handler passes its own ``stranded``
+        list, collected inside the death lock where quarantine blocks
+        new routes (the exactness argument in _on_replica_dead); the
+        retirement path collects here, after its drain.  Every victim
+        lands in the flight recorder as a ``stranded_failover`` so the
+        retry is explicable post-mortem."""
+        if stranded is None:
+            with self._lock:
+                stranded = [(route, inner)
+                            for (route, ix2, inner, _p)
+                            in self._inflight.values() if ix2 == ix]
+        for route, inner in stranded:
+            if not inner.done():
+                if _settle(inner, exc=ReplicaDeadError(message)):
+                    trace_id = (route.ctx.trace_id
+                                if route.ctx is not None else None)
+                    self._flight_event("stranded_failover",
+                                       trace_id=trace_id, replica=ix,
+                                       reason=reason)
+
     def health_snapshot(self) -> dict:
         """The ``/healthz`` provider: per-replica liveness + health
-        states, ``ok`` iff every replica is alive and un-quarantined."""
-        states = self.health_states()
-        alive = [svc.alive for svc in self._replicas]
+        states, ``ok`` iff every ACTIVE replica is alive and
+        un-quarantined (retired slots are an orderly state, not an
+        incident).  ``active`` is computed FIRST: a concurrent grow
+        appending slot N must not make a health probe index past the
+        lists it snapshotted (an autoscale event is not a 500)."""
+        active = self.active_indices()
+        replicas = []
+        for i in active:
+            svc = self._replicas[i]
+            replicas.append({"ix": i, "alive": svc.alive,
+                             "state": self._health[i].state,
+                             "queue_depth": svc.queue_depth()})
         return {
-            "ok": all(alive) and QUARANTINED not in states,
+            "ok": all(r["alive"] and r["state"] != QUARANTINED
+                      for r in replicas),
             "model": self.name,
-            "replicas": [
-                {"ix": i, "alive": alive[i], "state": states[i],
-                 "queue_depth": self._replicas[i].queue_depth()}
-                for i in range(len(self._replicas))],
+            "replicas": replicas,
+            "retired_slots": sorted(self._retired),
         }
 
     def start(self) -> None:
-        for svc in self._replicas:
-            svc.start()
+        self._started = True
+        retired = self._retired
+        for i, svc in enumerate(self._replicas):
+            if i not in retired:
+                svc.start()
 
     def stats(self) -> dict:
         """Set-level snapshot: per-replica service stats + health, the
@@ -667,17 +886,19 @@ class ReplicaSet:
         the window-bias audit — NOT replica 0's numbers and NOT a sum
         of per-replica rates with mismatched denominators)."""
         from bigdl_tpu.serving.metrics import ServingMetrics
+        active = self.active_indices()
         return {
             "model": self.name,
             "replicas": [
-                {"ix": i, "alive": svc.alive,
+                {"ix": i, "alive": self._replicas[i].alive,
                  "health": self._health[i].snapshot(),
-                 **svc.stats()}
-                for i, svc in enumerate(self._replicas)],
+                 **self._replicas[i].stats()}
+                for i in active],
+            "retired_slots": sorted(self._retired),
             "aggregate": ServingMetrics.aggregate(
-                [svc.metrics for svc in self._replicas],
-                queue_depth=sum(s.queue_depth()
-                                for s in self._replicas)),
+                [self._replicas[i].metrics for i in active],
+                queue_depth=sum(self._replicas[i].queue_depth()
+                                for i in active)),
             "resilience": self.registry.snapshot()["counters"],
         }
 

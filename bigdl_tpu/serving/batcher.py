@@ -131,11 +131,29 @@ class RequestBatcher:
     timeout, while concurrent load still coalesces.  The
     ``PredictionService`` shim runs in this mode to preserve its
     historical immediate-dispatch latency.
+
+    ``priority_fn`` is the QoS preemption hook (the frontend's
+    per-tenant admission layer supplies it): a callable mapping an
+    enqueued :class:`_Request` to an int rank (lower dispatches
+    first).  It engages ONLY under pressure — when the queued rows
+    exceed what one ``max_batch_size`` dispatch can carry — because
+    under light load every queued request rides the same coalesced
+    group anyway and FIFO order costs nothing.  Under pressure the
+    collect loop picks the best-(effective rank, arrival) request
+    that still fits, so latency-class tenants preempt batch-class
+    backlog; equal ranks stay FIFO.  Starvation is BOUNDED by aging:
+    a queued request's effective rank improves by one class per
+    ``priority_aging_ms`` waited, so sustained latency-class
+    saturation delays batch work by at most ~one aging period per
+    class gap instead of indefinitely.  ``None`` (the default) is
+    byte-identical to the pre-hook batcher.
     """
 
     def __init__(self, dispatch_fn: Callable[[List[_Request]], None],
                  *, max_batch_size: int, batch_timeout_ms: float,
-                 queue_capacity: int, name: str = "serving"):
+                 queue_capacity: int, name: str = "serving",
+                 priority_fn: Optional[Callable[["_Request"], int]] = None,
+                 priority_aging_ms: float = 500.0):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1: {max_batch_size}")
         if queue_capacity < 1:
@@ -145,9 +163,16 @@ class RequestBatcher:
         self.batch_timeout_s = float(batch_timeout_ms) / 1e3
         self.queue_capacity = int(queue_capacity)
         self._name = name
+        self._priority_fn = priority_fn
+        self._priority_aging_s = max(1e-3, priority_aging_ms / 1e3)
 
         self._cond = threading.Condition()
         self._q: deque[_Request] = deque()  # guarded-by: _cond
+        # running total of queued ROWS — kept in lockstep with _q so
+        # the QoS pressure test is O(1) per pop instead of re-summing
+        # the deque (O(queue_len) per pop is quadratic per dispatch
+        # exactly when the queue is full); guarded-by: _cond
+        self._q_rows = 0
         self._closed = False                # guarded-by: _cond
         self._drain = True                  # guarded-by: _cond
         self._thread: Optional[threading.Thread] = None
@@ -193,6 +218,7 @@ class RequestBatcher:
                     depth, self.queue_capacity, self._name,
                     retry_after_ms=self.retry_after_ms(depth))
             self._q.append(req)
+            self._q_rows += req.n_rows
             self._cond.notify_all()
 
     def depth(self) -> int:
@@ -202,14 +228,23 @@ class RequestBatcher:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """Idempotent; tests construct services with ``start=False`` to
-        stage a queue deterministically before the first dispatch."""
+        stage a queue deterministically before the first dispatch.
+        Concurrent callers must hold the service lifecycle lock (they
+        do: InferenceService.start/revive)."""
         if self._thread is None:
             # pre-start write: Thread.start() is the happens-before
             # edge, so the batcher thread observes it without a lock
             self.last_progress = time.monotonic()  # graftlint: disable=GL201
-            self._thread = threading.Thread(
+            thread = threading.Thread(
                 target=self._run, name=f"{self._name}-batcher", daemon=True)
-            self._thread.start()
+            thread.start()
+            # published only AFTER start(): a created-but-unstarted
+            # thread reads as is_alive()=False, and an outside liveness
+            # poll (the ReplicaSet supervisor) hitting that microsecond
+            # window would misread a healthy parked replica as DEAD and
+            # fail over its whole queue (caught by the elasticity tests
+            # staging parked sets under a live supervisor)
+            self._thread = thread
 
     @property
     def running(self) -> bool:
@@ -266,6 +301,7 @@ class RequestBatcher:
                     self.cancelled_rows += rows
                     return rows
                 req = self._q.popleft()
+                self._q_rows -= req.n_rows
             if req.future.cancel():
                 rows += req.n_rows
 
@@ -299,11 +335,60 @@ class RequestBatcher:
         if not drain:
             self._cancel_backlog()
 
+    # guarded-by: _cond
+    def _rank_locked(self, req: _Request, now: float) -> int:
+        """Effective QoS rank of one queued request: the declared rank
+        minus one class per aging period waited (the starvation bound
+        — a batch-class request that has queued ``priority_aging_ms``
+        competes as latency class).  A broken priority_fn ranks as 0
+        (most urgent) instead of killing the batcher thread."""
+        try:
+            rank = int(self._priority_fn(req))
+        except Exception:
+            return 0
+        return rank - int((now - req.t_enqueue)
+                          / self._priority_aging_s)
+
+    # guarded-by: _cond
+    def _pop_next_locked(self, rows: int) -> Optional[_Request]:
+        """Pop the next request for the current group, or None when the
+        candidate doesn't fit under ``max_batch_size``.  FIFO
+        (head-or-nothing — the historical contract) except under QoS
+        pressure: with a ``priority_fn`` set AND more rows queued than
+        one dispatch can carry, the best-(rank, arrival) request that
+        still fits is taken instead, so latency-class tenants preempt
+        batch backlog exactly when ordering starts to matter."""
+        if not self._q:
+            return None
+        pressure = (self._priority_fn is not None and len(self._q) > 1
+                    and rows + self._q_rows > self.max_batch_size)
+        if not pressure:
+            if self._q[0].n_rows + rows > self.max_batch_size:
+                return None
+            req = self._q.popleft()
+            self._q_rows -= req.n_rows
+            return req
+        best_i, best_key = -1, None
+        now = time.monotonic()
+        for i, r in enumerate(self._q):
+            if r.n_rows + rows > self.max_batch_size:
+                continue
+            # arrival ix = FIFO tie-break within an effective rank
+            key = (self._rank_locked(r, now), i)
+            if best_key is None or key < best_key:
+                best_key, best_i = key, i
+        if best_i < 0:
+            return None  # nothing queued fits in the remaining rows
+        req = self._q[best_i]
+        del self._q[best_i]
+        self._q_rows -= req.n_rows
+        return req
+
     def _collect(self, block: bool) -> List[_Request]:
         """Pop one coalescible group: wait (if ``block``) for the first
         request, then keep taking requests that fit under
         ``max_batch_size`` rows until the timeout since the first pop
-        expires or the next head doesn't fit."""
+        expires or the next candidate doesn't fit."""
         batch: List[_Request] = []
         rows = 0
         with self._cond:
@@ -311,20 +396,20 @@ class RequestBatcher:
                 self._cond.wait()
             if self._closed and not self._drain:
                 return batch  # backlog is _run's to CANCEL, not pop
-            if not self._q:
+            first = self._pop_next_locked(0)
+            if first is None:
                 return batch
-            first = self._q.popleft()
             batch.append(first)
             rows = first.n_rows
             deadline = time.monotonic() + self.batch_timeout_s
             while rows < self.max_batch_size:
-                if self._q:
-                    if self._q[0].n_rows + rows > self.max_batch_size:
-                        break  # head stays queued for the next group
-                    nxt = self._q.popleft()
+                nxt = self._pop_next_locked(rows)
+                if nxt is not None:
                     batch.append(nxt)
                     rows += nxt.n_rows
                     continue
+                if self._q:
+                    break  # queued work doesn't fit this group
                 if self._closed:
                     break  # draining: don't wait for traffic that won't come
                 remaining = deadline - time.monotonic()

@@ -218,17 +218,39 @@ class ModelRegistry:
                            f"deployed: {have}")
         return key
 
-    def _routed(self, name: str, version: Optional[int]):
+    def route(self, name: str, version: Optional[int] = None
+              ) -> Tuple[int, InferenceService,
+                         Optional[CircuitBreaker]]:
+        """Resolve one request's destination: ``(resolved_version,
+        service, breaker)``.  The wire frontend routes through this —
+        it needs the RESOLVED version (latest-wins + breaker fallback)
+        pinned for the whole wire exchange (a multi-chunk streaming
+        predict must not straddle a hot cutover) and the breaker to
+        feed the outcome back via :meth:`record_outcome`."""
         with self._lock:
             key = self._resolve(name, version)
-            return self._services[key], self._breakers.get(key)
+            return key[1], self._services[key], self._breakers.get(key)
+
+    def _routed(self, name: str, version: Optional[int]):
+        _v, svc, brk = self.route(name, version)
+        return svc, brk
+
+    def latest_version(self, name: str) -> Optional[int]:
+        """Newest deployed version of ``name`` (no breaker consult), or
+        None when the name has no deployments — what a hot cutover
+        reads BEFORE deploying to know which version it must drain."""
+        with self._lock:
+            return self._latest.get(name)
 
     @staticmethod
-    def _record_outcome(brk: Optional[CircuitBreaker],
-                        exc: Optional[BaseException]) -> None:
+    def record_outcome(brk: Optional[CircuitBreaker],
+                       exc: Optional[BaseException]) -> None:
         """Feed one request outcome to the served version's breaker.
         Overload/closed rejections say nothing about model poisoning
-        (documented breaker contract) — they are not recorded at all."""
+        (documented breaker contract) — they are not recorded at all.
+        Public because external routers (the wire frontend) that pin a
+        version via :meth:`route` owe the breaker the same feedback
+        the in-process paths give it."""
         if brk is None:
             return
         if exc is None:
@@ -247,9 +269,9 @@ class ModelRegistry:
         try:
             out = svc.predict(x, timeout=timeout)
         except BaseException as e:
-            self._record_outcome(brk, e)
+            self.record_outcome(brk, e)
             raise
-        self._record_outcome(brk, None)
+        self.record_outcome(brk, None)
         return out
 
     def submit(self, name: str, x, version: Optional[int] = None):
@@ -260,7 +282,7 @@ class ModelRegistry:
         # would reset a poisoned deploy's failure streak) nor a failure
         fut.add_done_callback(
             lambda f, _b=brk: None if f.cancelled()
-            else self._record_outcome(_b, f.exception()))
+            else self.record_outcome(_b, f.exception()))
         return fut
 
     def breaker_state(self, name: str, version: int) -> dict:

@@ -160,6 +160,15 @@ class InferenceService:
         own dispatch counter) — the chaos hook the resilience tests and
         ``bench.py --resilience`` drive.  ``None`` (the default) is the
         provably-inert state: the dispatch path never touches it.
+    priority_fn:
+        Optional QoS preemption hook handed to the
+        :class:`~bigdl_tpu.serving.batcher.RequestBatcher`: maps an
+        enqueued request (it carries ``.ctx`` with the tenant tag) to
+        an int rank, lower dispatching first — engaged only when the
+        queue holds more rows than one dispatch can carry.  ``None``
+        (the default) keeps the batcher byte-identical FIFO.  The
+        frontend's :class:`~bigdl_tpu.frontend.QosAdmission` supplies
+        its ``priority_fn`` here.
     tracer / request_tracing:
         Request-scoped observability (telemetry round 2).  ``tracer``
         is an optional :class:`~bigdl_tpu.telemetry.Tracer` — submit
@@ -178,7 +187,8 @@ class InferenceService:
                  buckets=None, workload: Optional[str] = None,
                  name: str = "model", start: bool = True,
                  fault_injector=None, tracer=None,
-                 request_tracing: Optional[bool] = None):
+                 request_tracing: Optional[bool] = None,
+                 priority_fn=None):
         from bigdl_tpu.engine import Engine
         self.workload = workload
         defaults = Engine.serving_defaults(workload)
@@ -252,6 +262,7 @@ class InferenceService:
         self._faults = fault_injector
         self._fault_replica: Optional[int] = None
         self._dispatch_index = 0
+        self._priority_fn = priority_fn
         # request-scoped observability (telemetry round 2): resolved
         # ONCE here — the submit/dispatch hot paths only test the
         # resulting attributes, never read config
@@ -312,7 +323,8 @@ class InferenceService:
         return RequestBatcher(
             dispatch, max_batch_size=self.max_batch_size,
             batch_timeout_ms=self.batch_timeout_ms,
-            queue_capacity=self.queue_capacity, name=self.name)
+            queue_capacity=self.queue_capacity, name=self.name,
+            priority_fn=self._priority_fn)
 
     # -- warmup ------------------------------------------------------------
     @staticmethod
@@ -413,6 +425,22 @@ class InferenceService:
     def output_row_shape(self) -> Optional[Tuple[int, ...]]:
         """Trailing dims of one output row (known after warmup)."""
         return self._out_row_shape
+
+    @property
+    def row_spec(self):
+        """The warmed per-row input spec (pytree of
+        ``jax.ShapeDtypeStruct``), or None before warmup — reusable as
+        another service's ``input_spec`` (ReplicaSet grow and hot
+        cutover both warm new executables off this)."""
+        return self._row_spec
+
+    @property
+    def drain_ewma_s(self) -> Optional[float]:
+        """The batcher's observed seconds-per-request EWMA (None before
+        its first dispatch) — the drain-rate signal ``retry_after_ms``
+        hints and the frontend autoscaler read.  Racy-by-design single
+        read of a single-writer float."""
+        return self._batcher._spr_ewma
 
     # -- request path ------------------------------------------------------
     def _normalize_input(self, x):
@@ -751,6 +779,22 @@ class InferenceService:
             _srv = _admin.current()
             if _srv is not None:
                 _srv.remove_source(self._admin_name)
+
+    def release(self) -> None:
+        """Drop params/state/bucket executables of a STOPPED service so
+        a retired replica slot stops pinning device memory until it is
+        reused (``ReplicaSet.set_replica_count`` shrink path).  Refuses
+        on a live service — the batcher thread still dispatches through
+        these references."""
+        if not self._stopped:
+            raise RuntimeError(
+                f"release() on live service {self.name!r}; stop() first")
+        self.params = None
+        self.state = None
+        with self._warm_lock:
+            self._compiled = {}
+            self._warmed = False
+            self._row_spec = None
 
     def __enter__(self) -> "InferenceService":
         return self

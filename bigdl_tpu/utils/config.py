@@ -164,6 +164,14 @@ class Config:
     # BIGDL_TPU_FLIGHT_RECORDER_PATH / _CAPACITY.
     flight_recorder_path: str = ""
     flight_recorder_capacity: int = 4096  # in-memory ring bound
+    # wire frontend (frontend/server.py): the port
+    # FrontendServer(port=None) binds the HTTP serving endpoint on.
+    # 0 (default) = the frontend refuses config-driven construction —
+    # unlike the admin plane nothing auto-starts either way; the wire
+    # surface only exists when a FrontendServer is explicitly built.
+    # Binds 127.0.0.1 only (X-Tenant is a tag, not a credential).
+    # Env: BIGDL_TPU_FRONTEND_PORT.
+    frontend_port: int = 0
     # mesh defaults (dryrun/tests override explicitly)
     mesh_data: int = -1
     mesh_model: int = 1

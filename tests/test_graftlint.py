@@ -1577,6 +1577,33 @@ class TestRealTree:
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 
+    def test_frontend_package_lints_clean(self):
+        """Standalone gate for the wire frontend (ISSUE-14): the HTTP
+        server, QoS admission, hot cutover and autoscaler are pure
+        host-side plumbing (stdlib http.server threads, token buckets,
+        condition-waited drain counters — no jax import anywhere in
+        the package), and the new threaded modules carry
+        `# guarded-by:` annotations from day one.  GL1xx and GL2xx
+        both run here; a violation means the wire plane grew either a
+        traced-scope hazard or an unguarded-shared-state regression."""
+        result = lint_paths([os.path.join(REPO, "bigdl_tpu",
+                                          "frontend")])
+        assert result.files_scanned == 5
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
+    def test_frontend_package_clean_under_gl2_select(self):
+        """The concurrency family alone over the frontend package —
+        the `--select GL2` gate ISSUE-14 names for the new threaded
+        modules (wire inflight counters, scale locks, controller
+        state)."""
+        result = lint_paths([os.path.join(REPO, "bigdl_tpu",
+                                          "frontend")],
+                            select=["GL2"])
+        assert result.files_scanned == 5
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
     def test_obs_plane_modules_lint_clean(self):
         """Standalone gate for the observability round-2 surface
         (ISSUE-11): the admin plane, flight recorder and request
@@ -1609,9 +1636,9 @@ class TestRealTree:
         result = lint_paths(
             [os.path.join(REPO, "bigdl_tpu", p)
              for p in ("serving", "resilience", "telemetry",
-                       "checkpoint")],
+                       "checkpoint", "frontend")],
             select=["GL2"])
-        assert result.files_scanned >= 18
+        assert result.files_scanned >= 23
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 

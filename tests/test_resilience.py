@@ -301,7 +301,7 @@ class TestCircuitBreaker:
         # contract: ModelRegistry must NOT record ServiceOverloaded /
         # ServiceClosed outcomes into the breaker
         reg = ModelRegistry(breaker_trip_after=1)
-        svc_outcomes = reg._record_outcome
+        svc_outcomes = reg.record_outcome
         brk = CircuitBreaker(trip_after=1)
         svc_outcomes(brk, ServiceOverloaded(5, 5, "m"))
         assert brk.allow()
@@ -937,6 +937,132 @@ class TestInertness:
             np.testing.assert_array_equal(out, ref)
         assert rs.stats()["resilience"]["resilience/sheds"] == 0
         bare.stop()
+        rs.stop()
+
+
+# ===========================================================================
+class TestReplicaElasticity:
+    """ISSUE 14 satellite: ``ReplicaSet.set_replica_count`` grow/shrink
+    — unit-tested independently of the autoscaler that drives it."""
+
+    def test_grow_warms_off_the_routing_path(self):
+        rs = ReplicaSet(make_model(), n_replicas=1, input_spec=SPEC16,
+                        max_batch_size=4, buckets="top", name="grow",
+                        start=False)
+        rep = rs.set_replica_count(3)
+        assert rep == {"active": 3, "added": [1, 2], "retired": []}
+        for ix in (1, 2):
+            svc = rs.replica(ix)
+            # fully AOT-warmed BEFORE admission: the grown replica
+            # never serves a compile stall
+            assert svc.warmed_up
+            # same trace bill replica 0 paid at construction (warmup
+            # probes + bucket executables)
+            assert svc.compile_count == rs.replica(0).compile_count
+        # staged routing spreads across all three (least-queue-depth)
+        rng = np.random.default_rng(0)
+        futs = [rs.submit(rows(rng, 1), timeout=30) for _ in range(3)]
+        assert [rs.replica(i).queue_depth() for i in range(3)] \
+            == [1, 1, 1]
+        rs.start()
+        for f in futs:
+            f.result(timeout=30)
+        rs.stop()
+
+    def test_shrink_drains_queued_work_without_a_death(self):
+        rs = ReplicaSet(make_model(), n_replicas=2, input_spec=SPEC16,
+                        max_batch_size=4, buckets="top",
+                        name="shrink", start=False)
+        rng = np.random.default_rng(1)
+        # stage work onto BOTH replicas, then retire one: its queued
+        # futures must resolve (inline drain), not cancel or fail over
+        futs = [rs.submit(rows(rng, 1), timeout=60) for _ in range(4)]
+        assert rs.replica(1).queue_depth() == 2
+        rep = rs.set_replica_count(1, timeout=30)
+        assert rep["retired"] == [1]
+        done = [f for f in futs if f.done()]
+        assert len(done) == 2  # exactly r1's staged work drained
+        for f in done:
+            assert f.exception() is None
+        snap = rs.registry.snapshot()["counters"]
+        assert snap["resilience/replica_deaths"] == 0
+        assert snap["resilience/replicas_retired"] == 1
+        # retired slot: excluded from routing, executables released
+        assert rs.n_replicas == 1 and rs.active_indices() == [0]
+        assert rs.replica(1).params is None
+        f5 = rs.submit(rows(rng, 1), timeout=30)
+        assert rs.replica(0).queue_depth() == 3
+        rs.start()
+        for f in futs + [f5]:
+            f.result(timeout=30)
+        rs.stop()
+
+    def test_shrink_under_live_load_resolves_everything(self):
+        rs = ReplicaSet(make_model(), n_replicas=3, input_spec=SPEC16,
+                        max_batch_size=4, buckets="top",
+                        name="live-shrink")
+        rng = np.random.default_rng(2)
+        errs = []
+        stop = threading.Event()
+
+        def caller():
+            while not stop.is_set():
+                try:
+                    rs.predict(rows(rng, 1), timeout=30)
+                except Exception as e:
+                    errs.append(f"{type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=caller) for _ in range(4)]
+        for t in threads:
+            t.start()
+        rs.set_replica_count(1, timeout=30)
+        rs.set_replica_count(2, timeout=30)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert errs == []
+        snap = rs.registry.snapshot()["counters"]
+        assert snap["resilience/replica_deaths"] == 0
+        rs.stop()
+
+    def test_slot_reuse_and_health_reset(self):
+        rs = ReplicaSet(make_model(), n_replicas=2, input_spec=SPEC16,
+                        max_batch_size=4, buckets="top", name="reuse",
+                        start=False)
+        rs.set_replica_count(1)
+        assert rs.health_snapshot()["retired_slots"] == [1]
+        rep = rs.set_replica_count(2)
+        assert rep["added"] == [1]  # the retired slot, reused
+        assert rs.health_snapshot()["retired_slots"] == []
+        assert rs.replica(1).warmed_up
+        assert rs.health_states()[1] == HEALTHY  # fresh ledger
+        assert rs.total_slots == 2
+        rs.stop()
+
+    def test_bounds_and_lifecycle_errors(self):
+        rs = ReplicaSet(make_model(), n_replicas=1, input_spec=SPEC16,
+                        max_batch_size=4, buckets="top",
+                        name="bounds", start=False)
+        with pytest.raises(ValueError):
+            rs.set_replica_count(0)
+        assert rs.set_replica_count(1) == {"active": 1, "added": [],
+                                           "retired": []}
+        rs.stop()
+        from bigdl_tpu.serving import ServiceClosed
+        with pytest.raises(ServiceClosed):
+            rs.set_replica_count(2)
+
+    def test_stats_and_health_exclude_retired(self):
+        rs = ReplicaSet(make_model(), n_replicas=2, input_spec=SPEC16,
+                        max_batch_size=4, buckets="top",
+                        name="statsx", start=False)
+        rs.set_replica_count(1)
+        health = rs.health_snapshot()
+        assert health["ok"] is True  # a retired slot is not an incident
+        assert [r["ix"] for r in health["replicas"]] == [0]
+        stats = rs.stats()
+        assert [r["ix"] for r in stats["replicas"]] == [0]
+        assert stats["retired_slots"] == [1]
         rs.stop()
 
 

@@ -87,10 +87,18 @@ def _trace_story_rows(trace: dict, meta: dict) -> List[dict]:
 
 
 def summarize(flight_blob: dict, trace: Optional[dict] = None,
-              trace_id: Optional[str] = None) -> dict:
+              trace_id: Optional[str] = None,
+              tenant: Optional[str] = None) -> dict:
     """Merge one flight dump (``telemetry.flight.load_dump``) and an
     optional Chrome trace into the report dict (the schema the fixture
-    test gates)."""
+    test gates).
+
+    ``tenant`` narrows the report to one tenant's request stories: a
+    trace id belongs to tenant T when ANY of its rows carries
+    ``tenant: T`` (the wire frontend stamps it on ``wire_request`` /
+    ``request_submit`` spans via the RequestContext), and the timeline
+    keeps only those requests' rows — so "what happened to acme's
+    traffic during the incident" is one flag."""
     meta = flight_blob.get("meta") or {}
     events = list(flight_blob.get("events") or [])
     if not events and trace is None:
@@ -116,6 +124,12 @@ def summarize(flight_blob: dict, trace: Optional[dict] = None,
     if trace_id is not None:
         timeline = [r for r in timeline
                     if r.get("trace_id") == trace_id]
+    if tenant is not None:
+        tenant_tids = {r["trace_id"] for r in timeline
+                       if r.get("trace_id")
+                       and (r.get("args") or {}).get("tenant") == tenant}
+        timeline = [r for r in timeline
+                    if r.get("trace_id") in tenant_tids]
 
     counts: Dict[str, int] = defaultdict(int)
     cats: Dict[str, int] = defaultdict(int)
@@ -200,6 +214,9 @@ def main(argv=None) -> int:
                                    "process (Tracer.dump / /trace)")
     p.add_argument("--trace-id", dest="trace_id",
                    help="only the timeline of one request/run")
+    p.add_argument("--tenant",
+                   help="only requests tagged with this tenant "
+                        "(wire frontend X-Tenant / RequestContext)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the report as JSON")
     p.add_argument("--limit", type=int, default=200,
@@ -211,7 +228,8 @@ def main(argv=None) -> int:
         if args.trace:
             from tools.trace_report import load_trace
             trace = load_trace(args.trace)
-        report = summarize(blob, trace=trace, trace_id=args.trace_id)
+        report = summarize(blob, trace=trace, trace_id=args.trace_id,
+                           tenant=args.tenant)
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
         print(f"obs_report: {e}", file=sys.stderr)
         return 2
