@@ -158,11 +158,13 @@ class QosAdmission:
         fields).  Undeclared tenants are admitted with ``default``'s
     class/budget and metered under the ``_other`` bucket — unless
         ``strict=True``, where they are refused
-        (:class:`UnknownTenantError` → HTTP 403 at the wire).
+        (:class:`UnknownTenantError` → HTTP 403 at the wire).  Strict
+        refuses TENANTLESS requests (no ``X-Tenant`` header) too:
+        omitting the header is not a way around the gate.
     default:
         The :class:`TenantSpec` applied to undeclared tenants and to
-        tenantless requests (no ``X-Tenant`` header).  Defaults to an
-        unlimited latency-class spec.
+        tenantless requests (no ``X-Tenant`` header) when ``strict``
+        is off.  Defaults to an unlimited latency-class spec.
     registry:
         The :class:`MetricRegistry` per-tenant counters land in (the
         frontend shares its own, so one ``/metrics`` page carries wire
@@ -226,13 +228,23 @@ class QosAdmission:
         """Admission verdict for one wire request.  Returns the
         tenant's spec on success; raises :class:`TenantRateLimited`
         (shed — counted) or, under ``strict``,
-        :class:`UnknownTenantError` for undeclared tenants."""
+        :class:`UnknownTenantError` for undeclared AND tenantless
+        requests."""
         mt = self._metric_tenant(tenant)
-        if self.strict and tenant is not None \
-                and tenant not in self._specs:
+        if self.strict and tenant not in self._specs:
+            # tenantless requests are refused too: omitting X-Tenant
+            # must not be a cheaper path through a strict gate than
+            # sending an undeclared one.  The message never enumerates
+            # declared tenant names — X-Tenant is a tag, not a
+            # credential, so listing valid tags on a 403 would hand an
+            # unauthenticated caller the exact bypass for the gate
+            if tenant is None:
+                raise UnknownTenantError(
+                    "request carries no tenant and admission is "
+                    "strict — send X-Tenant with a declared tenant")
             raise UnknownTenantError(
                 f"tenant {tenant!r} is not declared and admission is "
-                f"strict; declared: {sorted(self._specs)}")
+                f"strict")
         spec = self.spec(tenant)
         if tenant is not None and tenant in self._specs:
             # declared: its own bucket, or None when unlimited

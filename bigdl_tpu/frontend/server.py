@@ -27,7 +27,8 @@ service), built with the same stdlib-only discipline as
   returns 429 with ``Retry-After`` (seconds, ceiling) and
   ``X-Retry-After-Ms`` (exact) from ``ServiceOverloaded.
   retry_after_ms``; a missed deadline returns 504; an unknown model
-  404; a malformed request 400; strict-mode unknown tenants 403.
+  404; a malformed request 400; strict-mode undeclared (or missing)
+  tenants 403.
 - **Deadlines ride a header**: ``X-Deadline-Ms: 250`` becomes the
   monotonic deadline propagated into the existing
   ``serving/batcher._Request.deadline`` path — expired work is refused
@@ -67,7 +68,8 @@ from bigdl_tpu.frontend.qos import (QosAdmission, TenantRateLimited,
                                     UnknownTenantError)
 from concurrent.futures import TimeoutError as FutureTimeoutError
 
-from bigdl_tpu.serving.batcher import (DeadlineExceeded, ServiceClosed,
+from bigdl_tpu.serving.batcher import (DeadlineExceeded,
+                                       RequestSpecError, ServiceClosed,
                                        ServiceOverloaded)
 from bigdl_tpu.telemetry.context import RequestContext
 from bigdl_tpu.telemetry.registry import MetricRegistry
@@ -237,7 +239,7 @@ class FrontendServer:
         # counters pre-created so a zero-traffic scrape shows the schema
         for c in ("requests", "responses_2xx", "responses_4xx",
                   "responses_5xx", "sheds", "deadline_504",
-                  "stream_chunks"):
+                  "stream_chunks", "client_disconnects"):
             self.metrics.counter(f"frontend/{c}")
         self._latency_h = self.metrics.histogram("frontend/wire_latency_s")
         # admin plane: the wire+tenant registry and the tracer scrape
@@ -333,13 +335,21 @@ class FrontendServer:
     @staticmethod
     def _submit(backend, x, deadline: Optional[float], ctx):
         """Uniform submit over the two backend shapes.  Returns a
-        Future."""
+        Future.  :class:`RequestSpecError` is the backend refusing the
+        request's SHAPE (``_conform_request`` spec validation) — that
+        is the client's fault, so it wraps to 400 here; any OTHER
+        synchronous error (e.g. a deferred-spec warmup compile
+        failure) and anything the future later resolves with stay
+        server-side stories (500)."""
         from bigdl_tpu.resilience.replica_set import ReplicaSet
-        if isinstance(backend, ReplicaSet):
-            timeout = (None if deadline is None
-                       else max(0.0, deadline - time.monotonic()))
-            return backend.submit(x, timeout=timeout, ctx=ctx)
-        return backend.submit(x, deadline=deadline, ctx=ctx)
+        try:
+            if isinstance(backend, ReplicaSet):
+                timeout = (None if deadline is None
+                           else max(0.0, deadline - time.monotonic()))
+                return backend.submit(x, timeout=timeout, ctx=ctx)
+            return backend.submit(x, deadline=deadline, ctx=ctx)
+        except RequestSpecError as e:
+            raise _HTTPError(400, str(e)) from None
 
     @staticmethod
     def _backend_max_batch(backend) -> int:
@@ -409,7 +419,12 @@ class FrontendServer:
             if not isinstance(payload, dict) or "inputs" not in payload:
                 raise _HTTPError(
                     400, 'JSON body must be {"inputs": ...}')
-            x = _parse_inputs(payload["inputs"])
+            try:
+                x = _parse_inputs(payload["inputs"])
+            except (ValueError, TypeError) as e:
+                # e.g. ragged nested lists np.asarray refuses
+                raise _HTTPError(
+                    400, f"unparseable inputs: {e}") from None
         try:
             leaves = ([x] if not isinstance(x, dict)
                       else list(x.values()))
@@ -421,8 +436,8 @@ class FrontendServer:
         try:
             for attempt in range(3):
                 key, backend, brk = self._resolve_pinned(name, version)
-                max_batch = self._backend_max_batch(backend)
-                try:
+                try:  # pin held: EVERY exit path below must unpin
+                    max_batch = self._backend_max_batch(backend)
                     if rows <= max_batch:
                         out = self._predict_once(backend, x, deadline,
                                                  ctx, brk)
@@ -519,7 +534,8 @@ class FrontendServer:
                     except ServiceOverloaded as e:
                         if inflight:
                             sent += self._flush_one(handler, inflight,
-                                                    remaining(), brk)
+                                                    remaining(), brk,
+                                                    ensure_started)
                             continue
                         # foreign traffic owns the queue: honor the
                         # drain hint briefly instead of hot-spinning,
@@ -556,7 +572,22 @@ class FrontendServer:
                 # the REAL status code (and _run_predict's cutover
                 # retry on ServiceClosed still applies)
                 raise
+            if isinstance(e, ConnectionError):
+                # the client hung up mid-stream — THEIR outcome, not a
+                # server fault: no traceback, and no responses_5xx
+                # (which would corrupt the 5xx SLO signal on every
+                # reset); a dedicated counter keeps it observable
+                self.metrics.counter(
+                    "frontend/client_disconnects").inc()
+                return False
             status, body, _hdrs = self._classify(e)
+            if status >= 500 and status != 504 \
+                    and not isinstance(e, _HTTPError):
+                # same contract as do_POST's 5xx path: an internal bug
+                # after the 200 header is committed must still leave a
+                # traceback, not vanish into an ndjson error line
+                logger.exception(
+                    "frontend mid-stream 5xx after %d rows", sent)
             self._count_status(status)
             try:
                 handler.send_chunk(json.dumps(
@@ -608,8 +639,11 @@ class FrontendServer:
             return 403, {"error": str(e)}, {}
         if isinstance(e, ServiceClosed):
             return 503, {"error": str(e)}, {}
-        if isinstance(e, (ValueError, TypeError)):
-            return 400, {"error": f"{type(e).__name__}: {e}"}, {}
+        # NO blanket ValueError/TypeError → 400: client-driven parse
+        # and validation errors are wrapped in _HTTPError where they
+        # are raised, so an unexpected one here is a server bug that
+        # must report 500 and hit the 5xx traceback log, not hide as
+        # a client error
         return 500, {"error": f"{type(e).__name__}: {e}"}, {}
 
     def _count_status(self, status: int) -> None:
@@ -706,8 +740,12 @@ class FrontendServer:
                     return
                 body_read = False
                 try:
-                    length = int(self.headers.get("Content-Length",
-                                                  -1))
+                    try:
+                        length = int(self.headers.get("Content-Length",
+                                                      -1))
+                    except ValueError:
+                        raise _HTTPError(
+                            400, "unreadable Content-Length") from None
                     if length < 0:
                         raise _HTTPError(
                             411, "Content-Length required")

@@ -27,7 +27,8 @@ reference repo's ``PredictionService.scala`` — whose Python twin in
 """
 
 from bigdl_tpu.serving.batcher import (
-    DeadlineExceeded, RequestBatcher, ServiceClosed, ServiceOverloaded,
+    DeadlineExceeded, RequestBatcher, RequestSpecError, ServiceClosed,
+    ServiceOverloaded,
 )
 from bigdl_tpu.serving.metrics import LatencyReservoir, ServingMetrics
 from bigdl_tpu.serving.registry import ModelRegistry
@@ -36,5 +37,6 @@ from bigdl_tpu.serving.service import InferenceService, pad_rows, row_buckets
 __all__ = [
     "InferenceService", "ModelRegistry", "RequestBatcher",
     "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
-    "ServingMetrics", "LatencyReservoir", "row_buckets",
+    "RequestSpecError", "ServingMetrics", "LatencyReservoir",
+    "row_buckets",
 ]

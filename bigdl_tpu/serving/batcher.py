@@ -63,6 +63,17 @@ class ServiceClosed(RuntimeError):
     """submit() after close() — the service no longer accepts work."""
 
 
+class RequestSpecError(ValueError):
+    """The REQUEST's shape is wrong: it does not conform to the
+    deployed ``input_spec`` (tree structure / trailing-shape mismatch)
+    or exceeds ``max_batch_size``.  Raised synchronously by ``submit``
+    so a malformed request fails alone instead of poisoning the batch
+    it would have coalesced into.  Subclasses ``ValueError`` for
+    backward compatibility; the distinct type lets callers (the wire
+    frontend's 400 mapping) tell caller-fault validation apart from an
+    internal ``ValueError``, which stays a server-side bug."""
+
+
 def settle_future(fut: Future, *, result=None,
                   exc: Optional[BaseException] = None) -> bool:
     """Resolve a request future, tolerating the race where someone

@@ -36,8 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.serving.batcher import (
-    DeadlineExceeded, RequestBatcher, ServiceClosed, ServiceOverloaded,
-    _Request, settle_future,
+    DeadlineExceeded, RequestBatcher, RequestSpecError, ServiceClosed,
+    ServiceOverloaded, _Request, settle_future,
 )
 from bigdl_tpu.serving.metrics import ServingMetrics
 
@@ -94,13 +94,15 @@ def parse_row_buckets(spec: str, max_batch_size: int) -> Tuple[int, ...]:
 
 
 def leading_rows(x) -> int:
+    # RequestSpecError (a ValueError): the REQUEST is malformed — the
+    # wire frontend maps it to 400 instead of a server-fault 500
     leaves = _tree.tree_leaves(x)
     if not leaves:
-        raise ValueError("empty input pytree")
+        raise RequestSpecError("empty input pytree")
     n = leaves[0].shape[0] if leaves[0].ndim else None
     for leaf in leaves:
         if leaf.ndim == 0 or leaf.shape[0] != n:
-            raise ValueError(
+            raise RequestSpecError(
                 "all input leaves must share one leading batch dim; got "
                 f"shapes {[leaf.shape for leaf in leaves]}")
     return n
@@ -462,14 +464,21 @@ class InferenceService:
         if spec_def != req_def or any(
                 leaf.shape[1:] != tuple(s.shape)
                 for leaf, s in zip(req_leaves, spec_leaves)):
-            raise ValueError(
+            raise RequestSpecError(
                 f"request does not match the deployed input_spec of "
                 f"{self.name!r}: expected per-row "
                 f"{[(tuple(s.shape), str(s.dtype)) for s in spec_leaves]}"
                 f", got {[leaf.shape[1:] for leaf in req_leaves]}")
-        conformed = [leaf if leaf.dtype == s.dtype
-                     else np.asarray(leaf, dtype=s.dtype)
-                     for leaf, s in zip(req_leaves, spec_leaves)]
+        try:
+            conformed = [leaf if leaf.dtype == s.dtype
+                         else np.asarray(leaf, dtype=s.dtype)
+                         for leaf, s in zip(req_leaves, spec_leaves)]
+        except (ValueError, TypeError) as e:
+            # data the spec dtype refuses (e.g. strings into f32) is
+            # the request's fault, same as a shape mismatch
+            raise RequestSpecError(
+                f"request data does not coerce to the deployed "
+                f"input_spec dtypes of {self.name!r}: {e}") from None
         return _tree.tree_unflatten(req_def, conformed)
 
     def submit(self, x, *, deadline: Optional[float] = None,
@@ -497,7 +506,7 @@ class InferenceService:
             f.set_result(self._empty_output())
             return f
         if n > self.max_batch_size:
-            raise ValueError(
+            raise RequestSpecError(
                 f"request of {n} rows exceeds max_batch_size="
                 f"{self.max_batch_size}; use predict() which chunks")
         if deadline is not None and time.monotonic() >= deadline:

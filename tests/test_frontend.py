@@ -25,6 +25,7 @@ genuinely asynchronous HTTP client threads.
 
 import http.client
 import json
+import logging
 import math
 import threading
 import time
@@ -145,12 +146,18 @@ class TestQosAdmission:
         assert snap["serving/tenant=_other/shed"] == 1
         assert snap["serving/tenant=vip/requests"] == 1
 
-    def test_strict_refuses_undeclared(self):
-        qos = QosAdmission([TenantSpec("a")], strict=True)
-        with pytest.raises(UnknownTenantError):
+    def test_strict_refuses_undeclared_and_tenantless(self):
+        qos = QosAdmission([TenantSpec("sekrit-vip")], strict=True)
+        with pytest.raises(UnknownTenantError) as ei:
             qos.admit("b")
-        qos.admit(None)  # tenantless stays admitted (default spec)
-        qos.admit("a")
+        # the 403 must not enumerate declared names — X-Tenant is a
+        # tag, not a credential, so listing valid tags IS the bypass
+        assert "sekrit-vip" not in str(ei.value)
+        with pytest.raises(UnknownTenantError) as ei:
+            # omitting the tenant must not bypass a strict gate
+            qos.admit(None)
+        assert "sekrit-vip" not in str(ei.value)
+        qos.admit("sekrit-vip")
 
     def test_priority_ranks(self):
         qos = QosAdmission([TenantSpec("slo", qos_class=LATENCY),
@@ -386,6 +393,212 @@ class TestWireE2E:
         fe.stop()
         reg.stop_all()
 
+    def test_streaming_overload_midstream_flushes_and_completes(self):
+        """Regression (REVIEW): a streaming predict that hits
+        ServiceOverloaded with chunks in flight must flush the oldest
+        chunk (committing the 200 chunked header) and keep going — the
+        backpressure path used to call ``_flush_one`` with the
+        ``ensure_started`` argument missing and crash with TypeError."""
+        from bigdl_tpu.serving import ServiceOverloaded
+        model = make_model()
+        reg = ModelRegistry()
+        svc = reg.deploy("narrow", model, input_spec=SPEC16,
+                         max_batch_size=2, queue_capacity=2,
+                         buckets="top", start=False)
+        # parked + a filler occupying one of the two queue slots: the
+        # stream's chunk 1 fills the queue, so chunk 2's submit sheds
+        # while chunk 1 is still in flight — the exact branch under test
+        rng = np.random.default_rng(11)
+        f_fill = svc.submit(rows(rng, 1))
+        overloads = []
+        orig_submit = svc.submit
+
+        def counting_submit(x, **kw):
+            try:
+                return orig_submit(x, **kw)
+            except ServiceOverloaded:
+                overloads.append(1)
+                raise
+
+        svc.submit = counting_submit
+        fe = FrontendServer(reg, port=0)
+        fe.start()
+        xs = rows(rng, 8)  # 4 chunks of 2 > max_batch → stream path
+        result = {}
+
+        def client():
+            result["r"] = post(
+                fe.port, "/v1/models/narrow/predict",
+                json.dumps({"inputs": xs.tolist()}).encode())
+
+        t = threading.Thread(target=client)
+        t.start()
+        # the handler thread has provably entered the shed-with-
+        # inflight branch before the service is allowed to drain
+        wait_until(lambda: overloads, what="mid-stream overload")
+        svc.start()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        f_fill.result(30)
+        status, hdrs, body = result["r"]
+        fe.stop()
+        reg.stop_all()
+        assert status == 200, body
+        assert hdrs["Content-Type"] == "application/x-ndjson"
+        lines = [json.loads(ln) for ln in body.splitlines()]
+        assert lines[-1]["done"] is True and lines[-1]["rows"] == 8
+        chunks = sorted(lines[:-1], key=lambda c: c["offset"])
+        assert [c["offset"] for c in chunks] == [0, 2, 4, 6]
+        got = np.concatenate(
+            [np.asarray(c["outputs"], np.float32) for c in chunks])
+        ref, _ = model.apply(svc.params, svc.state, xs, training=False)
+        np.testing.assert_array_equal(got, np.asarray(ref))
+
+    def test_internal_fault_is_500_and_releases_pin(self):
+        """Regression (REVIEW): a server-side fault inside the pinned
+        window (here: a backend with no ``max_batch_size``) must report
+        500 — NOT masquerade as a client 400 — and must release the
+        wire-inflight pin so ``drain_version``/HotCutover never wedge
+        on a request that crashed."""
+
+        class NoBatchBackend:  # max_batch_size lookup raises
+            pass
+
+        fe = FrontendServer(backends={"broken": NoBatchBackend()},
+                            port=0)
+        fe.start()
+        x = json.dumps({"inputs": rows(np.random.default_rng(0),
+                                       1).tolist()}).encode()
+        status, _h, body = post(fe.port, "/v1/models/broken/predict", x)
+        assert status == 500, body
+        assert fe.inflight.count(("broken", 0)) == 0
+        assert fe.drain_version("broken", 0, timeout=0.5)
+        fe.stop()
+
+    def test_classify_unexpected_errors_are_500(self):
+        """Internal ValueError/TypeError are server bugs (500, logged
+        with traceback) — only _HTTPError-wrapped parse/validation
+        failures earn a 400."""
+        assert FrontendServer._classify(TypeError("bug"))[0] == 500
+        assert FrontendServer._classify(ValueError("bug"))[0] == 500
+
+    def test_backend_valueerror_is_500_unless_spec_error(self):
+        """Only the backend's RequestSpecError (spec validation — the
+        client's fault) maps to 400; any other synchronous ValueError
+        from submit (e.g. a deferred-spec warmup compile failure) is a
+        server-side 500."""
+        from bigdl_tpu.serving import RequestSpecError
+
+        class Raising:
+            max_batch_size = 8
+
+            def __init__(self, exc):
+                self.exc = exc
+
+            def submit(self, x, **kw):
+                raise self.exc
+
+        fe = FrontendServer(backends={
+            "buggy": Raising(ValueError("trace failed inside warmup")),
+            "picky": Raising(RequestSpecError("row shape mismatch"))},
+            port=0)
+        fe.start()
+        x = json.dumps({"inputs": rows(np.random.default_rng(0),
+                                       1).tolist()}).encode()
+        s_bug, _h, body = post(fe.port, "/v1/models/buggy/predict", x)
+        s_spec, _h2, _b2 = post(fe.port, "/v1/models/picky/predict", x)
+        fe.stop()
+        assert s_bug == 500, body
+        assert s_spec == 400
+
+    def test_midstream_internal_fault_logs_and_error_line(self, caplog):
+        """An internal bug AFTER the 200 chunked header is committed
+        must leave a server-side traceback (same contract as the
+        single-request 5xx path) and terminate the stream with an
+        error line carrying status 500."""
+
+        class HalfBad:
+            max_batch_size = 2
+
+            def __init__(self):
+                self.calls = 0
+
+            def submit(self, x, **kw):
+                from concurrent.futures import Future
+                self.calls += 1
+                f = Future()
+                # chunk 1 is fine (commits the header); chunk 2
+                # resolves with an output json.dumps refuses
+                f.set_result(np.zeros((2, 1), np.float32)
+                             if self.calls == 1 else {"bad": set()})
+                return f
+
+        fe = FrontendServer(backends={"half": HalfBad()}, port=0)
+        fe.start()
+        xs = rows(np.random.default_rng(0), 4)  # 2 chunks of 2
+        with caplog.at_level(logging.ERROR, "bigdl_tpu.frontend"):
+            status, _h, body = post(
+                fe.port, "/v1/models/half/predict",
+                json.dumps({"inputs": xs.tolist()}).encode())
+        fe.stop()
+        assert status == 200  # header was committed by chunk 1
+        lines = [json.loads(ln) for ln in body.splitlines()]
+        assert lines[-1]["status"] == 500
+        assert lines[-1]["rows_streamed"] == 2
+        assert any("mid-stream" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_midstream_client_disconnect_not_counted_5xx(self):
+        """A client hanging up mid-stream is THEIR outcome: it lands in
+        frontend/client_disconnects, never responses_5xx (which would
+        corrupt the 5xx SLO signal on every reset)."""
+        closed = threading.Event()
+
+        class SlowTail:
+            max_batch_size = 2
+
+            def __init__(self):
+                self.calls = 0
+
+            def submit(self, x, **kw):
+                from concurrent.futures import Future
+                self.calls += 1
+                f = Future()
+                if self.calls == 1:
+                    f.set_result(np.zeros((2, 1), np.float32))
+                else:
+                    # chunks 2+ resolve only after the client has hung
+                    # up, so the stream writes provably race an RST
+                    def settle():
+                        closed.wait(30)
+                        time.sleep(0.05)  # let the RST land
+                        try:  # stream cancels stragglers on hang-up
+                            f.set_result(np.zeros((2, 1), np.float32))
+                        except Exception:
+                            pass  # cancelled first — expected
+                    threading.Thread(target=settle,
+                                     daemon=True).start()
+                return f
+
+        fe = FrontendServer(backends={"s": SlowTail()}, port=0)
+        fe.start()
+        xs = rows(np.random.default_rng(0), 8)  # 4 chunks of 2
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=30)
+        conn.request("POST", "/v1/models/s/predict",
+                     body=json.dumps({"inputs": xs.tolist()}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200  # chunk 1 committed the header
+        resp.read(10)
+        conn.close()  # hang up with 3 chunks still to stream
+        closed.set()
+        wait_until(lambda: fe.metrics.counter(
+            "frontend/client_disconnects").value == 1,
+            what="disconnect counted")
+        assert fe.metrics.counter("frontend/responses_5xx").value == 0
+        fe.stop()
+
     def test_npy_body_and_npy_accept(self, wire):
         fe, reg, svc, model = wire
         x = rows(np.random.default_rng(9), 3)
@@ -480,6 +693,12 @@ class TestWireE2E:
         status, _h, _b = post(fe.port, "/v1/models/clf/predict", x,
                               headers={"X-Tenant": "nobody"})
         assert status == 403
+        # no X-Tenant at all is refused the same way under strict
+        status, _h, _b = post(fe.port, "/v1/models/clf/predict", x)
+        assert status == 403
+        status, _h, _b = post(fe.port, "/v1/models/clf/predict", x,
+                              headers={"X-Tenant": "a"})
+        assert status == 200
         fe.stop()
         reg.stop_all()
 
@@ -496,6 +715,16 @@ class TestWireE2E:
         # wrong row shape fails THAT request with 400
         bad = json.dumps({"inputs": [[1.0, 2.0]]}).encode()
         assert post(fe.port, "/v1/models/clf/predict", bad)[0] == 400
+        # ragged rows np.asarray refuses are the client's fault too
+        ragged = json.dumps({"inputs": [[1.0], [1.0, 2.0]]}).encode()
+        assert post(fe.port, "/v1/models/clf/predict", ragged)[0] == 400
+        # dict leaves disagreeing on the leading batch dim → 400
+        mism = json.dumps({"inputs": {"a": [[1.0]],
+                                      "b": [[1.0], [2.0]]}}).encode()
+        assert post(fe.port, "/v1/models/clf/predict", mism)[0] == 400
+        # string data the spec dtype coercion refuses → 400
+        strs = json.dumps({"inputs": [["x"] * 16]}).encode()
+        assert post(fe.port, "/v1/models/clf/predict", strs)[0] == 400
         status, _h, body = post(fe.port, "/v1/models/bad/predict", x)
         assert status == 404 and "error" in json.loads(body)
 
