@@ -46,18 +46,26 @@ thread, no import-time side effects (the zero-extra-threads gate in
 ``tests/test_frontend.py``).  Everything here is host-side: no jax
 import; inputs/outputs are numpy pytrees.
 
-Security posture mirrors the admin plane: binds ``127.0.0.1`` only by
-default and there is NO auth — ``X-Tenant`` is a declared tag, not a
-credential.  A non-loopback bind is an explicit, logged choice.
+Security posture: binds ``127.0.0.1`` only by default, where the
+historical no-auth behavior is unchanged.  A NON-loopback bind is
+refused unless a bearer token is configured
+(``Config.frontend_auth_token`` / ``BIGDL_TPU_FRONTEND_AUTH_TOKEN`` or
+the ``auth_token=`` constructor arg); with a token configured, every
+request must carry ``Authorization: Bearer <token>`` (constant-time
+compared) or is refused 401 before the body is read.  ``X-Tenant``
+stays a declared QoS tag, never a credential — the ROADMAP item-1
+wire-auth gap, closed.
 """
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import re
 import threading
 import time
+import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from io import BytesIO
 from typing import Dict, Optional, Tuple
@@ -93,17 +101,17 @@ class _WireInflight:
         self._cond = threading.Condition()
         self._counts: Dict[Tuple[str, int], int] = {}  # guarded-by: _cond
 
-    def enter(self, key: Tuple[str, int]) -> None:
+    def enter(self, key: Tuple[str, int]) -> None:  # acquires: wire_inflight
         with self._cond:
-            self._counts[key] = self._counts.get(key, 0) + 1
+            self._counts[key] = self._counts.get(key, 0) + 1  # acquires: wire_inflight
 
-    def exit(self, key: Tuple[str, int]) -> None:
+    def exit(self, key: Tuple[str, int]) -> None:  # releases: wire_inflight
         with self._cond:
             n = self._counts.get(key, 0) - 1
             if n <= 0:
-                self._counts.pop(key, None)
+                self._counts.pop(key, None)  # releases: wire_inflight
             else:
-                self._counts[key] = n
+                self._counts[key] = n  # releases: wire_inflight
             self._cond.notify_all()
 
     def count(self, key: Tuple[str, int]) -> int:
@@ -202,13 +210,22 @@ class FrontendServer:
     name:
         Admin-plane source name (metrics/tracer registered under it
         when the admin plane is up).
+    auth_token:
+        Bearer token every request must present
+        (``Authorization: Bearer <token>``, constant-time compared;
+        401 otherwise).  ``None`` resolves
+        ``Config.frontend_auth_token`` / ``BIGDL_TPU_FRONTEND_AUTH_
+        TOKEN``; empty keeps the historical open behavior — but a
+        NON-loopback ``host`` is refused at construction without a
+        token.
     """
 
     def __init__(self, registry=None, *, backends: Optional[dict] = None,
                  qos: Optional[QosAdmission] = None,
                  port: Optional[int] = 0, host: str = "127.0.0.1",
                  tracer=None, name: str = "frontend",
-                 stream_window: int = 4):
+                 stream_window: int = 4,
+                 auth_token: Optional[str] = None):
         if port is None:
             from bigdl_tpu.utils.config import get_config
             port = int(getattr(get_config(), "frontend_port", 0) or 0)
@@ -230,6 +247,32 @@ class FrontendServer:
             # registry rather than running two half-pages
             self.metrics = qos.registry
         self.tracer = tracer
+        # auth/host validation FIRST — pure checks, before anything
+        # with an external side effect (the admin-plane registration
+        # below reserves a source name that only stop() releases; a
+        # constructor that registers then raises would leak it)
+        if auth_token is None:
+            from bigdl_tpu.utils.config import get_config
+            auth_token = getattr(get_config(), "frontend_auth_token",
+                                 "") or ""
+        self._auth_token = str(auth_token)
+        if host not in ("127.0.0.1", "localhost", "::1"):
+            if not self._auth_token:
+                # the ROADMAP item-1 wire-auth gap: X-Tenant is a QoS
+                # tag, not a credential — an open non-loopback bind
+                # would hand the serving plane to the network.  Refuse
+                # at construction, before any socket exists.
+                raise ValueError(
+                    f"refusing to bind non-loopback host {host!r} "
+                    "without an auth token — set "
+                    "Config.frontend_auth_token / "
+                    "BIGDL_TPU_FRONTEND_AUTH_TOKEN (requests then "
+                    "need `Authorization: Bearer <token>`) or bind "
+                    "127.0.0.1")
+            logger.warning(
+                "wire frontend binding non-loopback host %r with "
+                "bearer-token auth; X-Tenant remains a QoS tag, not a "
+                "credential", host)
         self._stream_window = max(1, int(stream_window))
         self._lock = threading.Lock()
         self._backends: Dict[str, object] = dict(backends or {})  # guarded-by: _lock
@@ -252,11 +295,6 @@ class FrontendServer:
             _srv.add_registry(self._admin_name, self.metrics)
             if self.tracer is not None:
                 _srv.add_tracer(self._admin_name, self.tracer)
-        if host not in ("127.0.0.1", "localhost", "::1"):
-            logger.warning(
-                "wire frontend binding non-loopback host %r — X-Tenant "
-                "is a tag, not a credential; make sure the network "
-                "trusts it", host)
 
     # -- backends ----------------------------------------------------------
     def add_backend(self, name: str, backend) -> "FrontendServer":
@@ -289,6 +327,7 @@ class FrontendServer:
             raise _HTTPError(404, str(e)) from None
         return (name, v), svc, brk
 
+    # acquires: wire_inflight
     def _resolve_pinned(self, name: str, version: Optional[int]):
         """Resolve AND pin (wire-inflight enter) atomically enough for
         cutover: between ``route()`` and ``inflight.enter()`` a hot
@@ -407,13 +446,21 @@ class FrontendServer:
         if ctype == _NPY:
             try:
                 x = np.load(BytesIO(body), allow_pickle=False)
-            except Exception as e:
+            except (ValueError, OSError, EOFError,
+                    zipfile.BadZipFile) as e:
+                # the SPECIFIC malformed-bytes family np.load raises —
+                # a blanket except here would 400 internal bugs too
+                # (the GL302 taxonomy contract).  BadZipFile: a body
+                # starting with zip magic routes np.load through
+                # zipfile before any numpy validation
                 raise _HTTPError(
                     400, f"unreadable npy body: {e}") from None
         else:
             try:
                 payload = json.loads(body.decode("utf-8"))
-            except Exception as e:
+            except ValueError as e:
+                # JSONDecodeError and UnicodeDecodeError both subclass
+                # ValueError — the whole malformed-body family
                 raise _HTTPError(
                     400, f"unreadable JSON body: {e}") from None
             if not isinstance(payload, dict) or "inputs" not in payload:
@@ -717,8 +764,33 @@ class FrontendServer:
                 self.wfile.write(b"0\r\n\r\n")
                 self.wfile.flush()
 
+            def check_auth(self) -> bool:
+                """True when no token is configured (historical open
+                loopback) or the request carries the right bearer.
+                Refuses with 401 BEFORE the body is read (so the
+                connection closes — the 411/413 keep-alive desync
+                guard) and never echoes the expected token."""
+                tok = server._auth_token
+                if not tok:
+                    return True
+                hdr = self.headers.get("Authorization", "")
+                if hdr.startswith("Bearer ") and hmac.compare_digest(
+                        hdr[len("Bearer "):].strip(), tok):
+                    return True
+                self.close_connection = True  # body (if any) unread
+                try:
+                    self.send_json(
+                        401, {"error": "missing or invalid bearer "
+                                       "token"},
+                        {"WWW-Authenticate": "Bearer"})
+                except ConnectionError:
+                    pass
+                return False
+
             # -- routes -------------------------------------------------
             def do_GET(self):  # noqa: N802 - stdlib API
+                if not self.check_auth():
+                    return
                 if self.path == "/v1/models":
                     self.send_json(200, {"models": server.models()})
                 else:
@@ -729,6 +801,8 @@ class FrontendServer:
                                    "/predict"]})
 
             def do_POST(self):  # noqa: N802 - stdlib API
+                if not self.check_auth():
+                    return
                 m = _PREDICT_RE.match(self.path)
                 if m is None:
                     # the request body is never read on this path — a

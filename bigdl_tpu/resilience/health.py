@@ -128,7 +128,14 @@ class ReplicaHealth:
         """Routing verdict for one request: ``ADMIT`` (regular traffic),
         ``PROBE`` (this request is the quarantined replica's one
         probation probe — the caller must report its outcome with
-        ``probe=True``) or ``REFUSE``."""
+        ``probe=True``, or release the untried slot via
+        :meth:`cancel_probe`; the PR-10 review-round-1 leak was
+        exactly a consumed slot nobody released, which quarantined the
+        replica forever) or ``REFUSE``.  The slot inc/dec sites are
+        `# acquires:`/`# releases:`-tagged so GL303 keeps the pairing
+        checkable in this file; the cross-file caller contract
+        (``ReplicaSet._pick``/``_on_done``) stays prose — per-file
+        models are the unit."""
         if now is None:
             now = self._clock()
         with self._lock:
@@ -136,7 +143,7 @@ class ReplicaHealth:
                 return ADMIT
             if self._probe_inflight or now < self._next_probe_at:
                 return REFUSE
-            self._probe_inflight = True
+            self._probe_inflight = True  # acquires: probe_slot
             self._probes += 1
             self._count("probes")
             return PROBE
@@ -148,13 +155,13 @@ class ReplicaHealth:
         line from pure congestion).  The probe window stays as
         scheduled, so the next due request simply probes instead."""
         with self._lock:
-            self._probe_inflight = False
+            self._probe_inflight = False  # releases: probe_slot
 
     def record_success(self, probe: bool = False) -> None:
         with self._lock:
             self._consecutive_failures = 0
             if probe:
-                self._probe_inflight = False
+                self._probe_inflight = False  # releases: probe_slot
             if self._state == QUARANTINED:
                 if not probe:
                     return  # stale non-probe completion; wait for probe
@@ -173,7 +180,7 @@ class ReplicaHealth:
         with self._lock:
             self._consecutive_failures += 1
             if probe:
-                self._probe_inflight = False
+                self._probe_inflight = False  # releases: probe_slot
             if self._state == QUARANTINED:
                 if probe:
                     # failed probation: stay out, schedule the next
@@ -202,7 +209,7 @@ class ReplicaHealth:
             self._consecutive_failures = max(
                 self._consecutive_failures,
                 self.policy.quarantine_after)
-            self._probe_inflight = False
+            self._probe_inflight = False  # releases: probe_slot
             self._quarantine_locked(now)
 
     def next_probe_in(self, now: Optional[float] = None) -> float:

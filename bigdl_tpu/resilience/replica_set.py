@@ -458,7 +458,11 @@ class ReplicaSet:
                                        attempt=len(route.ctx.hops))
             token = next(self._token)
             with self._lock:
-                self._inflight[token] = (route, ix, inner, probe)
+                # every entry stored here is popped by exactly one
+                # _on_done (late completion, supervisor timeout and
+                # stranded-sweep all settle `inner`, which fires the
+                # done callback) — the GL303-tracked pairing
+                self._inflight[token] = (route, ix, inner, probe)  # acquires: rs_inflight
                 self._ensure_supervisor_locked()
                 self._wake.notify_all()
             inner.add_done_callback(
@@ -468,7 +472,7 @@ class ReplicaSet:
     # -------------------------------------------------------- completion
     def _on_done(self, token) -> None:
         with self._lock:
-            entry = self._inflight.pop(token, None)
+            entry = self._inflight.pop(token, None)  # releases: rs_inflight
         if entry is None:
             return
         route, ix, inner, probe = entry

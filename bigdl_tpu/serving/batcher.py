@@ -182,7 +182,10 @@ class RequestBatcher:
         # running total of queued ROWS — kept in lockstep with _q so
         # the QoS pressure test is O(1) per pop instead of re-summing
         # the deque (O(queue_len) per pop is quadratic per dispatch
-        # exactly when the queue is full); guarded-by: _cond
+        # exactly when the queue is full); guarded-by: _cond.  Every
+        # inc/dec is `# acquires:`/`# releases:`-tagged so GL303 keeps
+        # the pairing checkable (a pop path that forgets the decrement
+        # desynchronizes the QoS pressure signal forever).
         self._q_rows = 0
         self._closed = False                # guarded-by: _cond
         self._drain = True                  # guarded-by: _cond
@@ -229,7 +232,7 @@ class RequestBatcher:
                     depth, self.queue_capacity, self._name,
                     retry_after_ms=self.retry_after_ms(depth))
             self._q.append(req)
-            self._q_rows += req.n_rows
+            self._q_rows += req.n_rows  # acquires: queue_rows
             self._cond.notify_all()
 
     def depth(self) -> int:
@@ -312,7 +315,7 @@ class RequestBatcher:
                     self.cancelled_rows += rows
                     return rows
                 req = self._q.popleft()
-                self._q_rows -= req.n_rows
+                self._q_rows -= req.n_rows  # releases: queue_rows
             if req.future.cancel():
                 rows += req.n_rows
 
@@ -377,7 +380,7 @@ class RequestBatcher:
             if self._q[0].n_rows + rows > self.max_batch_size:
                 return None
             req = self._q.popleft()
-            self._q_rows -= req.n_rows
+            self._q_rows -= req.n_rows  # releases: queue_rows
             return req
         best_i, best_key = -1, None
         now = time.monotonic()
@@ -392,7 +395,7 @@ class RequestBatcher:
             return None  # nothing queued fits in the remaining rows
         req = self._q[best_i]
         del self._q[best_i]
-        self._q_rows -= req.n_rows
+        self._q_rows -= req.n_rows  # releases: queue_rows
         return req
 
     def _collect(self, block: bool) -> List[_Request]:

@@ -153,17 +153,17 @@ class ModelRegistry:
                 raise ValueError(
                     f"model {name!r} version {version} already deployed; "
                     "undeploy it first or bump the version")
-            self._pending.add(key)
+            self._pending.add(key)  # acquires: deploy_reservation
         try:
             service = InferenceService(
                 model, params, state, name=f"{name}:v{version}",
                 **service_kw)
         except BaseException:
             with self._lock:
-                self._pending.discard(key)
+                self._pending.discard(key)  # releases: deploy_reservation
             raise
         with self._lock:
-            self._pending.discard(key)
+            self._pending.discard(key)  # releases: deploy_reservation
             self._services[key] = service
             self._breakers[key] = CircuitBreaker(
                 trip_after=self._breaker_trip_after,

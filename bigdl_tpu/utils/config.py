@@ -172,6 +172,26 @@ class Config:
     # Binds 127.0.0.1 only (X-Tenant is a tag, not a credential).
     # Env: BIGDL_TPU_FRONTEND_PORT.
     frontend_port: int = 0
+    # wire-frontend auth (frontend/server.py): when set, every request
+    # must carry `Authorization: Bearer <token>` or is refused 401 —
+    # and a FrontendServer REFUSES to bind a non-loopback host unless
+    # a token is configured (X-Tenant stays a QoS tag, never a
+    # credential).  "" (default) keeps the historical loopback-open
+    # behavior.  Env: BIGDL_TPU_FRONTEND_AUTH_TOKEN.
+    frontend_auth_token: str = ""
+    # lockdep (utils/lockdep.py): TSan-lite lock-order sanitizer for
+    # the threaded host plane.  False (default) = provably inert — no
+    # wrapper object is ever allocated, threading.Lock/RLock stay the
+    # stdlib factories (the FaultInjector empty-plan discipline).
+    # True (or BIGDL_TPU_LOCKDEP=1) wraps lock CONSTRUCTION so every
+    # tier-1 run doubles as a deadlock hunt: per-thread held-lock
+    # stacks accrete a global acquisition-order graph and a cycle is
+    # reported AT ACQUIRE TIME with both conflicting stacks.
+    # lockdep_hold_ms additionally records holds longer than the
+    # threshold (blocking-under-lock, GL206's runtime twin); 0
+    # disables the wall-clock check.
+    lockdep: bool = False
+    lockdep_hold_ms: float = 200.0
     # mesh defaults (dryrun/tests override explicitly)
     mesh_data: int = -1
     mesh_model: int = 1

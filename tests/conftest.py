@@ -14,6 +14,27 @@ os.environ["XLA_FLAGS"] = (
     + " --xla_force_host_platform_device_count=8"
 )
 
+# Lockdep opt-in (BIGDL_TPU_LOCKDEP=1): install the lock-order
+# sanitizer BEFORE any product module constructs a lock, so every
+# tier-1 run doubles as a deadlock hunt.  The module is loaded
+# standalone by file path (registered under its canonical name) —
+# importing it through the bigdl_tpu package would drag in the whole
+# tree and create product locks ahead of the patch.
+_LOCKDEP_MOD = None
+if os.environ.get("BIGDL_TPU_LOCKDEP", "").lower() in (
+        "1", "true", "yes", "on"):
+    import importlib.util
+    import sys as _sys
+    _ld_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "bigdl_tpu", "utils", "lockdep.py")
+    _spec = importlib.util.spec_from_file_location(
+        "bigdl_tpu.utils.lockdep", _ld_path)
+    _LOCKDEP_MOD = importlib.util.module_from_spec(_spec)
+    _sys.modules["bigdl_tpu.utils.lockdep"] = _LOCKDEP_MOD
+    _spec.loader.exec_module(_LOCKDEP_MOD)
+    _LOCKDEP_MOD.install(hold_ms=float(
+        os.environ.get("BIGDL_TPU_LOCKDEP_HOLD_MS", "200")))
+
 import jax  # noqa: E402
 
 # NOTE: the env var JAX_PLATFORMS is stomped by the axon TPU plugin in this
@@ -37,3 +58,27 @@ def _reset_engine_mesh():
     prev = Engine._state.mesh
     yield
     Engine._state.mesh = prev
+
+
+def pytest_report_header(config):
+    if _LOCKDEP_MOD is not None:
+        return ["lockdep: lock-order sanitizer INSTALLED "
+                "(BIGDL_TPU_LOCKDEP) — cycles fail the session"]
+    return []
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """The lockdep gate: a run under BIGDL_TPU_LOCKDEP=1 fails when
+    any lock-order cycle was recorded, with both stacks printed."""
+    if _LOCKDEP_MOD is None:
+        return
+    cycles = _LOCKDEP_MOD.cycles()
+    edges = len(_LOCKDEP_MOD.graph_edges())
+    slow = len(_LOCKDEP_MOD.slow_holds())
+    print(f"\nlockdep: {_LOCKDEP_MOD.proxies_allocated()} locks "
+          f"instrumented, {edges} order edges, {len(cycles)} cycles, "
+          f"{slow} slow holds")
+    if cycles:
+        for c in cycles:
+            print(c.render())
+        session.exitstatus = 1

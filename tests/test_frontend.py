@@ -306,6 +306,19 @@ class TestWireE2E:
         np.testing.assert_array_equal(
             np.asarray(out["outputs"], np.float32), np.asarray(ref))
 
+    def test_zip_magic_npy_body_is_400_not_500(self, wire):
+        # review regression: a body starting with zip magic routes
+        # np.load through zipfile, whose BadZipFile is not in the
+        # ValueError/OSError/EOFError family — it must still be the
+        # client's 400, not a 500 + traceback any caller can flood
+        fe, _reg, _svc, _model = wire
+        status, _hdrs, body = post(
+            fe.port, "/v1/models/clf/predict",
+            b"PK\x03\x04garbage-not-a-real-zip",
+            headers={"Content-Type": "application/x-npy"})
+        assert status == 400
+        assert "unreadable npy body" in json.loads(body)["error"]
+
     def test_concurrent_clients_bitwise_and_dispatch_budget(self):
         """THE acceptance gate: concurrent wire clients, bitwise
         outputs, coalesced into a bounded number of dispatches."""
@@ -1159,6 +1172,117 @@ class TestFrontendInertness:
         fe.stop()
         assert not fe.running
         reg.stop_all()
+
+
+# ===========================================================================
+@pytest.fixture(scope="class")
+def auth_wire():
+    """A live frontend with bearer-token auth over one direct
+    backend."""
+    model = make_model()
+    svc = InferenceService(model, input_spec=SPEC16, max_batch_size=8,
+                           batch_timeout_ms=0.0, buckets="top",
+                           name="authed")
+    fe = FrontendServer(backends={"clf": svc}, port=0,
+                        auth_token="s3cret-tok")
+    fe.start()
+    yield fe, svc, model
+    fe.stop()
+    svc.stop()
+
+
+class TestWireAuth:
+    """ISSUE-15 satellite (ROADMAP item 1's wire-auth gap): a
+    non-loopback bind requires a bearer token, and a configured token
+    is enforced on every route before the body is read.  X-Tenant
+    stays a QoS tag, never a credential; loopback-without-token keeps
+    the historical open behavior (every other class in this file)."""
+
+    def test_non_loopback_bind_refused_without_token(self):
+        with pytest.raises(ValueError, match="non-loopback"):
+            FrontendServer(port=0, host="0.0.0.0")
+        # refusal happens at CONSTRUCTION: no socket, no thread
+        names = {t.name for t in threading.enumerate()}
+        assert "bigdl-tpu-frontend" not in names
+
+    def test_non_loopback_allowed_with_token(self):
+        fe = FrontendServer(port=0, host="0.0.0.0",
+                            auth_token="deadbeef")
+        assert fe._auth_token == "deadbeef"
+        assert not fe.running  # constructed, never started
+
+    def test_config_token_resolution(self):
+        from bigdl_tpu.utils.config import configure, reset_config
+        configure(frontend_auth_token="cfg-tok")
+        try:
+            fe = FrontendServer(port=0, host="0.0.0.0")  # no raise
+            assert fe._auth_token == "cfg-tok"
+        finally:
+            reset_config()
+
+    def test_missing_token_is_401_before_body_read(self, auth_wire):
+        fe, svc, model = auth_wire
+        x = rows(np.random.default_rng(0), 2)
+        status, hdrs, body = post(
+            fe.port, "/v1/models/clf/predict",
+            json.dumps({"inputs": x.tolist()}).encode())
+        assert status == 401
+        assert hdrs["WWW-Authenticate"] == "Bearer"
+        assert "bearer" in json.loads(body)["error"]
+        # the refusal never reached admission or the backend queue
+        assert svc.stats()["requests_submitted"] == 0
+
+    def test_wrong_and_malformed_tokens_are_401(self, auth_wire):
+        fe, _svc, _model = auth_wire
+        x = rows(np.random.default_rng(1), 1)
+        body = json.dumps({"inputs": x.tolist()}).encode()
+        for hdr in ({"Authorization": "Bearer wrong"},
+                    {"Authorization": "s3cret-tok"},      # no scheme
+                    {"Authorization": "Basic s3cret-tok"},
+                    {"X-Tenant": "acme"}):                # tag ≠ cred
+            status, _h, _b = post(fe.port,
+                                  "/v1/models/clf/predict", body,
+                                  headers=hdr)
+            assert status == 401, hdr
+
+    def test_correct_token_serves_bitwise(self, auth_wire):
+        fe, svc, model = auth_wire
+        x = rows(np.random.default_rng(2), 3)
+        status, _hdrs, body = post(
+            fe.port, "/v1/models/clf/predict",
+            json.dumps({"inputs": x.tolist()}).encode(),
+            headers={"Authorization": "Bearer s3cret-tok"})
+        assert status == 200
+        ref, _ = model.apply(svc.params, svc.state, x, training=False)
+        np.testing.assert_array_equal(
+            np.asarray(json.loads(body)["outputs"], np.float32),
+            np.asarray(ref))
+
+    def test_get_routes_enforced_too(self, auth_wire):
+        fe, _svc, _model = auth_wire
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=30)
+        try:
+            conn.request("GET", "/v1/models")
+            assert conn.getresponse().status == 401
+        finally:
+            conn.close()
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=30)
+        try:
+            conn.request("GET", "/v1/models",
+                         headers={"Authorization": "Bearer s3cret-tok"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert "clf" in json.loads(resp.read())["models"]
+        finally:
+            conn.close()
+
+    def test_401s_counted_as_4xx_not_sheds(self, auth_wire):
+        fe, _svc, _model = auth_wire
+        scalars = fe.metrics.scalars()
+        assert scalars["frontend/responses_4xx"] >= 5
+        assert scalars["frontend/sheds"] == 0
 
 
 if __name__ == "__main__":

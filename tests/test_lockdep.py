@@ -1,0 +1,432 @@
+"""lockdep — the runtime half of the deadlock story (ISSUE 15).
+
+Layout:
+- THE POSITIVE GATE: a real 2-lock cycle across 2 threads is detected
+  at acquire time and the report names BOTH conflicting stacks;
+- negatives: consistent order, reentrant RLocks, Condition wait/notify
+  round-trips and same-class lock pairs record no cycle;
+- the slow-hold (blocking-under-lock) wall-clock check;
+- THE INERTNESS GATE: lockdep off allocates NO wrapper (bitwise
+  factory identity, zero proxies) and the driver loop is bitwise
+  identical with ``maybe_install()`` called under the off config —
+  the FaultInjector empty-plan discipline, applied to locks.
+
+When the whole suite runs under ``BIGDL_TPU_LOCKDEP=1`` (the conftest
+opt-in) the sanitizer is session-installed and its graph must stay
+cycle-free — so the tests here that deliberately MANUFACTURE a cycle
+(or uninstall/reset the global state) skip themselves rather than
+poison the session gate; the session run still executes the negative
+accounting tests, which is the point of the opt-in.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.transformer import Sample, SampleToMiniBatch
+from bigdl_tpu.utils import lockdep
+from bigdl_tpu.utils.config import configure, reset_config
+
+_SESSION_LOCKDEP = os.environ.get("BIGDL_TPU_LOCKDEP", "").lower() in (
+    "1", "true", "yes", "on")
+
+needs_isolation = pytest.mark.skipif(
+    _SESSION_LOCKDEP,
+    reason="session-wide lockdep is installed (BIGDL_TPU_LOCKDEP=1); "
+           "this test manufactures cycles / resets global state and "
+           "would poison the session's zero-cycle gate")
+
+
+@pytest.fixture
+def sandbox():
+    """Fresh install for one test, fully torn down after."""
+    assert not lockdep.installed()
+    lockdep.install(hold_ms=0)
+    lockdep.reset()
+    try:
+        yield lockdep
+    finally:
+        lockdep.uninstall()
+        lockdep.reset()
+
+
+def _run_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(10.0)
+    assert not t.is_alive()
+
+
+# ===========================================================================
+@needs_isolation
+class TestCycleDetection:
+    def test_two_lock_cycle_across_two_threads_names_both_stacks(
+            self, sandbox):
+        """THE ISSUE-15 acceptance gate: t1 takes A then B, t2 takes B
+        then A — no actual deadlock occurs (the threads run
+        sequentially), but the order graph must report the inversion
+        at acquire time, naming both sides' stacks."""
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def order_ab_worker():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def order_ba_worker():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        _run_thread(order_ab_worker)
+        assert lockdep.cycles() == []          # one order alone is fine
+        _run_thread(order_ba_worker)
+        cycles = lockdep.cycles()
+        assert len(cycles) == 1
+        report = cycles[0].render()
+        # the report names BOTH conflicting acquisition stacks: the
+        # acquiring side (t2's frame) and the recorded edge (t1's
+        # frames, held + acquired)
+        assert "order_ba_worker" in report
+        assert "order_ab_worker" in report
+        assert "held at" in report and "acquired at" in report
+        # and both lock allocation sites (this file)
+        assert report.count("test_lockdep.py") >= 3
+
+    def test_cycle_reported_once_per_site_pair(self, sandbox):
+        # separate lines: same-line allocations share one site and
+        # form ONE lock class (the family semantics, tested below)
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        _run_thread(ab)
+        for _ in range(3):
+            _run_thread(ba)
+        assert len(lockdep.cycles()) == 1       # no cascade
+
+    def test_three_lock_cycle_through_the_graph(self, sandbox):
+        """A -> B, B -> C, then C -> A: the cycle closes through a
+        PATH, not a direct edge — the graph search, not pairwise
+        bookkeeping, finds it."""
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.Lock()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with c:
+                    pass
+
+        def t3():
+            with c:
+                with a:
+                    pass
+
+        _run_thread(t1)
+        _run_thread(t2)
+        assert lockdep.cycles() == []
+        _run_thread(t3)
+        cycles = lockdep.cycles()
+        assert len(cycles) == 1
+        assert len(cycles[0].path) == 3        # c -> a -> b(=c's blocker)
+
+
+# ===========================================================================
+@needs_isolation
+class TestNoFalsePositives:
+    def test_consistent_order_from_many_threads_is_clean(self, sandbox):
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def worker():
+            for _ in range(20):
+                with a:
+                    with b:
+                        pass
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10.0)
+        assert lockdep.cycles() == []
+        assert (next(iter(lockdep.graph_edges().values()))) >= 4
+
+    def test_rlock_reentrancy_records_no_self_edge(self, sandbox):
+        r = threading.RLock()
+        with r:
+            with r:
+                pass
+        assert lockdep.cycles() == []
+        assert lockdep.graph_edges() == {}
+
+    def test_condition_wait_notify_round_trip_is_clean(self, sandbox):
+        """Condition() rides the patched RLock factory; wait() releases
+        through ``_release_save`` and re-acquires through
+        ``_acquire_restore`` — the held-stack accounting must survive
+        the round trip without phantom holds or edges."""
+        cond = threading.Condition()
+        box = []
+
+        def consumer():
+            with cond:
+                while not box:
+                    cond.wait(5.0)
+                # still holds cond here: nesting another lock is a
+                # legitimate edge, not a phantom
+                with threading.Lock():
+                    pass
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            box.append(1)
+            cond.notify_all()
+        t.join(10.0)
+        assert not t.is_alive()
+        assert lockdep.cycles() == []
+
+    def test_same_class_lock_pairs_are_not_edges(self, sandbox):
+        """Two instances from ONE allocation site (a lock family, e.g.
+        per-replica death locks) nested in both orders must not
+        report — with site-keyed classes the direction is ambiguous,
+        and same-object re-takes are GL202's static domain."""
+        family = [threading.Lock() for _ in range(2)]
+
+        def fwd():
+            with family[0]:
+                with family[1]:
+                    pass
+
+        def rev():
+            with family[1]:
+                with family[0]:
+                    pass
+
+        _run_thread(fwd)
+        _run_thread(rev)
+        assert lockdep.cycles() == []
+
+    def test_queue_and_futures_machinery_is_clean(self, sandbox):
+        import queue
+        from concurrent.futures import ThreadPoolExecutor
+        q = queue.Queue(maxsize=4)
+        with ThreadPoolExecutor(2) as ex:
+            futs = [ex.submit(q.put, i) for i in range(4)]
+            for f in futs:
+                f.result(5.0)
+        assert q.qsize() == 4
+        assert lockdep.cycles() == []
+
+
+# ===========================================================================
+@needs_isolation
+class TestSlowHold:
+    def test_hold_past_threshold_recorded_with_acquire_stack(self):
+        assert not lockdep.installed()
+        lockdep.install(hold_ms=20.0)
+        lockdep.reset()
+        try:
+            lk = threading.Lock()
+
+            def slow_holder():
+                with lk:
+                    time.sleep(0.06)
+
+            _run_thread(slow_holder)
+            holds = lockdep.slow_holds()
+            assert len(holds) == 1
+            assert holds[0].held_s >= 0.02
+            assert "slow_holder" in holds[0].render()
+            assert lockdep.cycles() == []      # advisory, not a cycle
+        finally:
+            lockdep.uninstall()
+            lockdep.reset()
+
+    def test_threshold_zero_disables_the_check(self, sandbox):
+        lk = threading.Lock()
+        with lk:
+            time.sleep(0.03)
+        assert lockdep.slow_holds() == []
+
+
+# ===========================================================================
+class RecordingSummary:
+    def __init__(self):
+        self.losses = []
+
+    def add_train_step(self, step, loss, lr, throughput):
+        self.losses.append(loss)
+
+    def add_scalar(self, *a):
+        pass
+
+    def trigger_for(self, name):
+        return None
+
+
+def tiny_run(iters=6, k=1):
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(0, 1, (16,)).astype(np.float32),
+                      np.int32(rng.integers(0, 4)))
+               for _ in range(64)]
+    model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(),
+                          nn.Linear(16, 4), nn.LogSoftMax())
+    rec = RecordingSummary()
+    opt = (optim.LocalOptimizer(model,
+                                DataSet.array(samples)
+                                >> SampleToMiniBatch(16),
+                                nn.ClassNLLCriterion())
+           .set_optim_method(optim.SGD(learning_rate=0.1))
+           .set_seed(7)
+           .set_train_summary(rec)
+           .set_steps_per_dispatch(k)
+           .set_end_when(optim.max_iteration(iters)))
+    opt.optimize()
+    return np.asarray(rec.losses), opt
+
+
+# ===========================================================================
+class TestInertness:
+    """The ISSUE-15 acceptance gate: lockdep OFF is bitwise — no
+    wrapper object exists, the stdlib factories are untouched, and the
+    driver loop is unchanged (loss sequence + dispatch count)."""
+
+    @needs_isolation
+    def test_off_state_is_structurally_inert(self):
+        assert threading.Lock is lockdep._ORIG_LOCK
+        assert threading.RLock is lockdep._ORIG_RLOCK
+        before = lockdep.proxies_allocated()
+        # the config gate declines without touching anything
+        configure(lockdep=False)
+        try:
+            assert lockdep.maybe_install() is False
+        finally:
+            reset_config()
+        assert not lockdep.installed()
+        assert threading.Lock is lockdep._ORIG_LOCK
+        lk = threading.Lock()
+        assert type(lk) is not lockdep._LockProxy
+        assert lockdep.proxies_allocated() == before  # NOTHING allocated
+
+    @needs_isolation
+    @pytest.mark.parametrize("k", [1, 4])
+    def test_driver_bitwise_with_maybe_install_under_off_config(self, k):
+        before = lockdep.proxies_allocated()
+        base_l, base_o = tiny_run(iters=6, k=k)
+        configure(lockdep=False)
+        try:
+            assert lockdep.maybe_install() is False
+            off_l, off_o = tiny_run(iters=6, k=k)
+        finally:
+            reset_config()
+        np.testing.assert_array_equal(base_l, off_l)
+        assert base_o._dispatch_count == off_o._dispatch_count
+        assert lockdep.proxies_allocated() == before
+        assert threading.Lock is lockdep._ORIG_LOCK
+
+    def test_maybe_install_honors_config_on(self):
+        """With lockdep configured ON, maybe_install patches (and in a
+        session-lockdep run, finds it already installed)."""
+        was = lockdep.installed()
+        configure(lockdep=True)
+        try:
+            assert lockdep.maybe_install() is True
+            assert lockdep.installed()
+            assert threading.Lock is lockdep._lock_factory
+        finally:
+            reset_config()
+            if not was:
+                lockdep.uninstall()
+                lockdep.reset()
+        assert lockdep.installed() == was
+
+    @needs_isolation
+    def test_driver_runs_green_under_lockdep(self):
+        """The sanitizer ON must not perturb semantics either: same
+        losses as the uninstrumented run (locks guard host plumbing,
+        not math), zero cycles from the driver plane."""
+        base_l, _ = tiny_run(iters=4)
+        lockdep.install(hold_ms=0)
+        lockdep.reset()
+        try:
+            on_l, _ = tiny_run(iters=4)
+        finally:
+            lockdep.uninstall()
+            lockdep.reset()
+        np.testing.assert_array_equal(base_l, on_l)
+        assert lockdep.cycles() == []
+
+
+# ===========================================================================
+class TestLifecycle:
+    @needs_isolation
+    def test_install_uninstall_idempotent(self):
+        lockdep.install(hold_ms=0)
+        lockdep.install(hold_ms=0)     # no double-patch
+        assert threading.Lock is lockdep._lock_factory
+        lockdep.uninstall()
+        lockdep.uninstall()
+        assert threading.Lock is lockdep._ORIG_LOCK
+        lockdep.reset()
+
+    @needs_isolation
+    def test_existing_proxies_survive_uninstall(self):
+        lockdep.install(hold_ms=0)
+        lk = threading.Lock()
+        lockdep.uninstall()
+        with lk:                        # still a working lock
+            assert lk.locked()
+        assert not lk.locked()
+        lockdep.reset()
+
+    def test_check_clean_raises_with_report(self):
+        if _SESSION_LOCKDEP:
+            pytest.skip("would poison the session graph")
+        lockdep.install(hold_ms=0)
+        lockdep.reset()
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    with a:
+                        pass
+
+            _run_thread(ab)
+            _run_thread(ba)
+            with pytest.raises(lockdep.LockOrderError,
+                               match="lock-order cycle"):
+                lockdep.check_clean()
+        finally:
+            lockdep.uninstall()
+            lockdep.reset()
+        lockdep.check_clean()           # clean state passes

@@ -42,6 +42,13 @@ def main(argv=None) -> int:
     ap.add_argument("--stats", action="store_true",
                     help="print per-rule finding/suppression counts "
                          "(the suppression-debt dashboard) and exit 0")
+    ap.add_argument("--write-baseline", nargs="?", metavar="PATH",
+                    const="", default=None,
+                    help="with --stats: write the per-file suppression "
+                         "baseline JSON (default "
+                         "tools/graftlint/suppressions_baseline.json) "
+                         "— the reviewed act that admits net-new "
+                         "suppression debt past the tier-1 gate")
     ap.add_argument("--select", default=None,
                     help="comma-separated rule ids/names to run; an id "
                          "prefix selects a family (--select GL2 runs "
@@ -66,13 +73,20 @@ def main(argv=None) -> int:
               f"--format {args.format}", file=sys.stderr)
         return 2
 
-    paths = args.paths or ["bigdl_tpu"]
+    # default gate paths: the library AND the tools/ tree (bench.py
+    # helpers and tools/*.py threaded code are part of the product)
+    paths = args.paths or [p for p in ("bigdl_tpu", "tools", "bench.py")
+                           if os.path.exists(p)] or ["bigdl_tpu"]
     for p in paths:
         if not os.path.exists(p):
             print(f"graftlint: path not found: {p}", file=sys.stderr)
             return 2
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
+    if args.write_baseline is not None and not args.stats:
+        print("graftlint: --write-baseline requires --stats (the "
+              "baseline is the debt table, frozen)", file=sys.stderr)
+        return 2
     if args.stats:
         # --stats is a whole-tree dashboard: scoping or reformatting
         # flags it cannot honor are usage errors, not silent no-ops
@@ -85,8 +99,21 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         stats = core.lint_paths_stats(paths, select=select)
+        import json
+        if args.write_baseline is not None:
+            if select:
+                print("graftlint: --write-baseline must cover the "
+                      "full ruleset (drop --select)", file=sys.stderr)
+                return 2
+            out = args.write_baseline or core.BASELINE_DEFAULT_PATH
+            with open(out, "w", encoding="utf-8") as fh:
+                json.dump(core.baseline_document(stats, paths), fh,
+                          indent=2, sort_keys=True)
+                fh.write("\n")
+            # stderr: stdout carries the (possibly JSON) stats payload
+            print(f"graftlint: baseline written to {out}",
+                  file=sys.stderr)
         if fmt == "json":
-            import json
             print(json.dumps(stats, indent=2, sort_keys=True))
         else:
             print(core.stats_to_human(stats))

@@ -28,7 +28,7 @@ import re
 import subprocess
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
-from tools.graftlint import threads, tracing
+from tools.graftlint import resources, threads, tracing
 
 SEVERITIES = ("error", "warning")
 
@@ -159,6 +159,7 @@ class FileContext:
         self.suppressions = Suppressions(source)
         self.traced = tracing.TracedModel(self.tree, path)
         self.threads = threads.ThreadModel(self.tree, source, path)
+        self.resources = resources.ResourceModel(self.tree, source, path)
         norm = path.replace(os.sep, "/")
         base = os.path.basename(norm)
         self.is_test = ("/tests/" in norm or norm.startswith("tests/")
@@ -167,6 +168,10 @@ class FileContext:
         self.is_interop = "/interop/" in norm or norm.startswith("interop/")
         self.is_library = ("bigdl_tpu" in norm and not self.is_test
                            and not self.is_dataset)
+        # the wire plane: modules where HTTP statuses mean something —
+        # GL302's error-taxonomy scope
+        self.is_wire = any(f"/{p}/" in norm or norm.startswith(f"{p}/")
+                           for p in ("frontend", "serving"))
 
 
 # -------------------------------------------------------------------- drivers
@@ -388,6 +393,7 @@ def lint_paths_stats(paths: Sequence[str],
     rules = {r.id: {"name": r.name, "findings": 0, "suppressed": 0}
              for r in all_rules()
              if not select or _selected(r, select)}
+    by_file: Dict[str, Dict[str, int]] = {}
     files = list(iter_python_files(paths))
     for f in files:
         with open(f, "r", encoding="utf-8") as fh:
@@ -398,7 +404,83 @@ def lint_paths_stats(paths: Sequence[str],
                                       "suppressed": 0})["findings"] += 1
         for v in suppressed:
             rules[v.rule]["suppressed"] += 1
-    return {"files_scanned": len(files), "rules": rules}
+            row = by_file.setdefault(_relpath(f), {})
+            row[v.rule] = row.get(v.rule, 0) + 1
+    return {"files_scanned": len(files), "rules": rules,
+            "suppressions_by_file": {p: dict(sorted(r.items()))
+                                     for p, r in sorted(by_file.items())}}
+
+
+_RELPATH_ROOT: List[Optional[str]] = [None]  # memo: one git call per run
+
+
+def _relpath(path: str) -> str:
+    """Repo-relative, /-separated path for baseline keys (falls back to
+    the path as given when it is outside the repo root)."""
+    if _RELPATH_ROOT[0] is None:
+        try:
+            r = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                               capture_output=True, text=True,
+                               check=True)
+            _RELPATH_ROOT[0] = r.stdout.strip() or os.getcwd()
+        except (OSError, subprocess.CalledProcessError):
+            _RELPATH_ROOT[0] = os.getcwd()
+    rel = os.path.relpath(os.path.abspath(path), _RELPATH_ROOT[0])
+    if rel.startswith(".."):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+BASELINE_SCHEMA_VERSION = 1
+
+#: checked-in suppression-debt ledger (see suppression_debt_delta)
+BASELINE_DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "suppressions_baseline.json")
+
+
+def baseline_document(stats: dict, paths: Sequence[str]) -> dict:
+    """The ``--write-baseline`` payload: per-file per-rule suppression
+    counts, sorted for stable diffs.  Checked in at
+    ``tools/graftlint/suppressions_baseline.json`` and enforced by the
+    tier-1 gate in ``tests/test_graftlint.py``: counts may SHRINK
+    silently (debt paid down) but growing one requires regenerating
+    this file — a reviewed act — plus a triage-table row in
+    ``tools/graftlint/README.md``."""
+    return {
+        "schema_version": BASELINE_SCHEMA_VERSION,
+        "tool": "graftlint",
+        "generated_by": "python -m tools.graftlint --stats "
+                        "--write-baseline " + " ".join(paths),
+        "suppressions": stats.get("suppressions_by_file", {}),
+    }
+
+
+def load_baseline(path: str = BASELINE_DEFAULT_PATH) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("schema_version") != BASELINE_SCHEMA_VERSION \
+            or not isinstance(doc.get("suppressions"), dict):
+        raise ValueError(
+            f"unreadable suppression baseline {path}: regenerate with "
+            "`python -m tools.graftlint --stats --write-baseline`")
+    return doc
+
+
+def suppression_debt_delta(stats: dict, baseline: dict) -> List[str]:
+    """Human-readable list of (file, rule) whose CURRENT suppression
+    count exceeds the checked-in baseline — net-new suppression debt.
+    Empty when debt only shrank or held."""
+    out: List[str] = []
+    base = baseline.get("suppressions", {})
+    for path, row in sorted(stats.get("suppressions_by_file",
+                                      {}).items()):
+        for rule, n in sorted(row.items()):
+            allowed = base.get(path, {}).get(rule, 0)
+            if n > allowed:
+                out.append(f"{path}: {rule} suppressions {n} > "
+                           f"baseline {allowed}")
+    return out
 
 
 def stats_to_human(stats: dict) -> str:

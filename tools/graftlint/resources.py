@@ -1,0 +1,236 @@
+"""Resource model: which tracked resources exist, who acquires and
+releases them, and which call sites own an acquisition.
+
+The GL3xx rule family keys off this model the same way GL2xx keys off
+``threads.ThreadModel`` — it encodes the repo's own exception-path
+resource conventions rather than generic ones.  The bug class it
+exists for is PR 14's review round 4: a wire-inflight pin acquired by
+``_resolve_pinned`` leaked when a statement between the acquire and its
+``try/finally`` raised — the pin wedged ``HotCutover`` until timeout.
+Locks have ``with``; *counted* resources (inflight pins, probe slots,
+queue-row counters) have nothing — so the contract becomes a
+lightweight annotation the linter can check:
+
+- **``# acquires: <resource>`` on a ``def`` line** — calling this
+  function acquires the named resource and OWNERSHIP TRANSFERS TO THE
+  CALLER (``_WireInflight.enter``, ``_resolve_pinned``).  GL301 checks
+  every same-file call site: the acquisition must be covered by a
+  ``try/finally`` that releases it (or the calling function must
+  itself be ``# acquires:``-annotated, passing ownership further up).
+  A *may-acquire* API (``ReplicaHealth.admit`` returns whether this
+  request is the probe) uses the same annotation — the caller owns the
+  release on the paths where the acquire happened.
+- **``# releases: <resource>`` on a ``def`` line** — calling this
+  function releases the resource (``_WireInflight.exit``,
+  ``ReplicaHealth.cancel_probe``).  A ``finally`` body containing such
+  a call is what protects an acquisition.
+- **On a plain statement** (normally an attribute increment/decrement)
+  the annotations mark the PRIMITIVE inc/dec sites of a paired counter
+  (``self._q_rows += req.n_rows`` tagged ``# acquires: <resource>``
+  in ``serving/batcher.py``).  GL303
+  checks the pairing: a resource with acquire sites but no release
+  site anywhere in the file is a one-way counter, and any *unannotated*
+  mutation of a marked attribute (outside ``__init__``) is a new
+  inc/dec added outside the discipline.
+
+Placement follows the suppression/``guarded-by`` convention: a
+trailing comment annotates that statement (a ``def`` line annotates the
+function); a standalone comment line annotates the next statement.
+Several resources comma-separate.
+
+Resolution is NAME-based and same-file (the house model): a call whose
+last segment matches an annotated ``def`` in this file carries that
+def's resources.  Cross-module ownership (``replica_set`` calling
+``health.admit``) is out of scope — per-file contracts are the unit,
+exactly like the thread model; annotate the boundary def in its own
+file and keep the cross-file contract in prose.
+
+The model also carries the GL302 client-error declaration:
+``# graftlint: client-error=Name[,Name]`` extends the wire error
+taxonomy (the exception types allowed to map to HTTP 4xx) for one
+file.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.tracing import (FuncInfo, collect_functions,
+                                     iter_scope, last_seg)
+
+_RES_RE = re.compile(
+    r"#.*?\b(acquires|releases)\s*:\s*"
+    r"([A-Za-z_][A-Za-z0-9_]*(?:\s*,\s*[A-Za-z_][A-Za-z0-9_]*)*)")
+
+_CLIENT_DECL_RE = re.compile(
+    r"#\s*graftlint:\s*client-errors?\s*=\s*"
+    r"([A-Za-z_][A-Za-z0-9_]*(?:\s*,\s*[A-Za-z_][A-Za-z0-9_]*)*)")
+
+ACQUIRES = "acquires"
+RELEASES = "releases"
+
+
+class ResourceModel:
+    """Per-file acquire/release model (see module docstring)."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.path = path
+        self.lines = source.splitlines()
+
+        # function index (the shared tracing.collect_functions walker)
+        self.funcs: Dict[int, FuncInfo]
+        self.by_name: Dict[str, List[FuncInfo]]
+        self.funcs, self.by_name = collect_functions(tree)
+
+        # line -> (kind, {resources}) from the annotation comments
+        self._ann_lines = self._annotation_lines()
+
+        # id(def node) -> resources; and name -> resources for call
+        # resolution (union over same-named defs — name-based model)
+        self.def_acquires: Dict[int, Set[str]] = {}
+        self.def_releases: Dict[int, Set[str]] = {}
+        self.name_acquires: Dict[str, Set[str]] = {}
+        self.name_releases: Dict[str, Set[str]] = {}
+        # statement-level primitive sites:
+        # line -> (kind, {resources}) for non-def statements
+        self.stmt_sites: Dict[int, Tuple[str, Set[str]]] = {}
+        self._bind()
+
+        # GL303 bookkeeping: (class, attr) -> set of resources marked on
+        # its mutation sites, and every mutation site of those attrs
+        self.marked_attrs: Dict[Tuple[Optional[str], str], Set[str]] = {}
+        self._mark_attrs()
+
+        # GL302: file-extended client-error taxonomy
+        self.client_errors: Set[str] = set()
+        for line in self.lines:
+            m = _CLIENT_DECL_RE.search(line)
+            if m:
+                self.client_errors |= {t.strip()
+                                       for t in m.group(1).split(",")
+                                       if t.strip()}
+
+    def _annotation_lines(self) -> Dict[int, Tuple[str, Set[str]]]:
+        """statement line -> (kind, resources), with the standalone-
+        comment-annotates-next-statement placement rule."""
+        out: Dict[int, Tuple[str, Set[str]]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _RES_RE.search(line)
+            if not m:
+                continue
+            kind, names = m.groups()
+            toks = {t.strip() for t in names.split(",") if t.strip()}
+            if line.lstrip().startswith("#"):
+                j = i
+                while j < len(self.lines) and (
+                        not self.lines[j].strip()
+                        or self.lines[j].lstrip().startswith("#")):
+                    j += 1
+                out[j + 1] = (kind, toks)
+            else:
+                out[i] = (kind, toks)
+        return out
+
+    def _bind(self):
+        if not self._ann_lines:
+            return
+        def_lines = {fi.node.lineno: fi for fi in self.funcs.values()}
+        for line, (kind, toks) in self._ann_lines.items():
+            fi = def_lines.get(line)
+            if fi is not None:
+                dst = (self.def_acquires if kind == ACQUIRES
+                       else self.def_releases)
+                dst.setdefault(id(fi.node), set()).update(toks)
+                by = (self.name_acquires if kind == ACQUIRES
+                      else self.name_releases)
+                by.setdefault(fi.name, set()).update(toks)
+            else:
+                prev = self.stmt_sites.get(line)
+                if prev is not None and prev[0] != kind:
+                    # a statement can only be one kind; keep the first
+                    continue
+                if prev is not None:
+                    prev[1].update(toks)
+                else:
+                    self.stmt_sites[line] = (kind, set(toks))
+
+    # ----------------------------------------------------- GL303 attr marks
+    @staticmethod
+    def _mutated_attr(stmt: ast.AST) -> Optional[str]:
+        """Attribute name when ``stmt`` stores to ``self.X`` or
+        ``self.X[...]`` (Assign/AugAssign/AnnAssign/Delete), else
+        None."""
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                return t.attr
+        return None
+
+    def _mark_attrs(self):
+        for fi in self.funcs.values():
+            for stmt in iter_scope(fi.node):
+                if not isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                         ast.AnnAssign, ast.Delete)):
+                    continue
+                site = self.stmt_sites.get(stmt.lineno)
+                if site is None:
+                    continue
+                attr = self._mutated_attr(stmt)
+                if attr is not None:
+                    self.marked_attrs.setdefault(
+                        (fi.class_name, attr), set()).update(site[1])
+
+    # ------------------------------------------------------- call resolution
+    def call_acquires(self, call: ast.Call) -> Set[str]:
+        """Resources acquired by this call (name-based, same-file)."""
+        seg = last_seg(call.func)
+        if seg is None and isinstance(call.func, ast.Attribute):
+            seg = call.func.attr
+        return set(self.name_acquires.get(seg or "", set()))
+
+    def call_releases(self, call: ast.Call) -> Set[str]:
+        seg = last_seg(call.func)
+        if seg is None and isinstance(call.func, ast.Attribute):
+            seg = call.func.attr
+        return set(self.name_releases.get(seg or "", set()))
+
+    def releases_in(self, body: List[ast.stmt], resource: str) -> bool:
+        """Whether ``body`` (e.g. a ``finally`` suite) releases the
+        resource: a call to a release-annotated def, or a statement
+        annotated ``# releases: <resource>``."""
+        for stmt in body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) \
+                        and resource in self.call_releases(n):
+                    return True
+            site = self.stmt_sites.get(stmt.lineno)
+            if site is not None and site[0] == RELEASES \
+                    and resource in site[1]:
+                return True
+        return False
+
+    # ------------------------------------------------------- site inventory
+    def acquire_stmt_sites(self) -> List[Tuple[int, Set[str]]]:
+        return sorted((line, toks) for line, (kind, toks)
+                      in self.stmt_sites.items() if kind == ACQUIRES)
+
+    def release_stmt_sites(self) -> List[Tuple[int, Set[str]]]:
+        return sorted((line, toks) for line, (kind, toks)
+                      in self.stmt_sites.items() if kind == RELEASES)
+
+    def has_annotations(self) -> bool:
+        return bool(self.def_acquires or self.def_releases
+                    or self.stmt_sites)

@@ -7,5 +7,6 @@ from tools.graftlint.rules import (  # noqa: F401
     host_sync,
     purity,
     recompile,
+    resource_safety,
     tensor_branch,
 )

@@ -1,0 +1,399 @@
+"""GL301-GL303: exception-path resource safety in the wire/serving
+plane.
+
+PR 13 encoded the concurrency bugs of review rounds 10-12 as GL2xx;
+PR 14's review round then shipped a bug class those rules cannot see:
+
+- ``_backend_max_batch`` ran BETWEEN a wire-inflight pin acquire and
+  its ``try/finally`` — a raise there leaked the pin and wedged
+  ``HotCutover`` until timeout (GL301's class);
+- ``_classify`` mapped blanket ``ValueError/TypeError`` to HTTP 400 —
+  internal bugs masqueraded as client errors and never hit the 5xx SLO
+  or the traceback log (GL302's class);
+- the probe-slot leak of PR 10 review round 1 was the same shape one
+  layer down: a paired counter incremented on a path that never
+  decremented (GL303's class).
+
+The family keys off ``tools/graftlint/resources.py`` (the GL3xx analog
+of ``threads.py``): ``# acquires:`` / ``# releases:`` annotations on
+defs declare ownership-transferring APIs, the same annotations on
+statements mark the primitive inc/dec sites of paired counters, and
+``# graftlint: client-error=`` extends the wire error taxonomy.
+
+- GL301 leaked-acquire — a call to an ``# acquires:``-annotated
+  function whose acquisition is not covered by a ``try/finally`` that
+  releases the resource (and the caller does not itself transfer
+  ownership via its own ``# acquires:`` def annotation);
+- GL302 error-taxonomy — in wire/serving modules, a 4xx response fed
+  by a blanket ``except`` (``Exception``/``BaseException``/bare) or
+  selected by an ``isinstance`` test on a function parameter against a
+  type outside the declared client-error taxonomy.  Wrapping a
+  NARROWLY-typed exception from a specific client-input parse into
+  ``_HTTPError(400)``/``RequestSpecError`` at its origin is the
+  blessed pattern and stays silent;
+- GL303 release-on-all-paths — a marked paired counter with acquire
+  sites but no release site in the file (one-way resource), or an
+  unannotated mutation of a marked attribute (an inc/dec added outside
+  the discipline; ``__init__`` exempt — construction precedes
+  sharing).
+
+Scope: all non-test code for GL301/GL303; GL302 is scoped to the wire
+plane (``frontend/`` + ``serving/``) where HTTP statuses mean
+something.
+"""
+
+from __future__ import annotations
+
+import ast
+import types
+from typing import Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint import resources
+from tools.graftlint.core import Rule, register
+from tools.graftlint.tracing import iter_scope, last_seg
+
+#: exception types allowed to select a 4xx status — the declared wire
+#: client-error taxonomy (extend per file with
+#: ``# graftlint: client-error=Name``)
+CLIENT_ERROR_TYPES = {
+    "RequestSpecError", "_HTTPError", "HTTPError",
+    "UnknownTenantError", "TenantRateLimited", "ServiceOverloaded",
+}
+
+_BLANKET = {"Exception", "BaseException"}
+
+_SENDERS = {"send_json", "send_body", "send_error", "send_response",
+            "start_chunked"}
+
+_SIMPLE_STMTS = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Expr,
+                 ast.Return, ast.Raise, ast.Assert, ast.Delete)
+
+_TRY_STAR = getattr(ast, "TryStar", None)  # except* is py3.11+
+
+
+def _in_scope(ctx) -> bool:
+    return not ctx.is_test
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    seg = last_seg(call.func)
+    if seg is None and isinstance(call.func, ast.Attribute):
+        seg = call.func.attr
+    return seg
+
+
+# ============================================================= GL301
+@register
+class LeakedAcquireRule(Rule):
+    id = "GL301"
+    name = "leaked-acquire"
+    severity = "error"
+    description = ("a tracked resource (`# acquires:`-annotated call) "
+                   "acquired outside a try/finally that releases it on "
+                   "every raise path — the PR-14 wire-inflight pin-leak "
+                   "class")
+
+    def check(self, ctx) -> Iterator:
+        if not _in_scope(ctx):
+            return
+        model: resources.ResourceModel = ctx.resources
+        if not (model.name_acquires or model.stmt_sites):
+            return
+        for fi in model.funcs.values():
+            yield from self._check_func(ctx, model, fi)
+
+    def _check_func(self, ctx, model, fi):
+        owned = model.def_acquires.get(id(fi.node), set())
+        body = getattr(fi.node, "body", [])
+        yield from self._walk_block(ctx, model, owned, body, [])
+
+    def _walk_block(self, ctx, model, owned, block, tries):
+        for i, stmt in enumerate(block):
+            nxt = block[i + 1] if i + 1 < len(block) else None
+            for call in self._own_calls(stmt):
+                for r in sorted(model.call_acquires(call) - owned):
+                    if self._protected(model, r, tries, nxt):
+                        continue
+                    yield self.violation(
+                        ctx, call, f"`{_callee_name(call)}()` acquires "
+                        f"`{r}` but no try/finally on this path "
+                        f"releases it — a raise between here and the "
+                        "release leaks the resource (the PR-14 "
+                        "wire-inflight pin-leak class); make the next "
+                        "statement a `try:` whose `finally` releases "
+                        f"`{r}`, or annotate this function "
+                        f"`# acquires: {r}` to transfer ownership to "
+                        "its caller")
+            # recurse into compound bodies with updated try context
+            if isinstance(stmt, ast.Try):
+                inner = tries + ([stmt] if stmt.finalbody else [])
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.body, inner)
+                for h in stmt.handlers:
+                    yield from self._walk_block(ctx, model, owned,
+                                                h.body, inner)
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.orelse, inner)
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.finalbody, tries)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.body, tries)
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.orelse, tries)
+            elif isinstance(stmt, ast.If):
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.body, tries)
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.orelse, tries)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.body, tries)
+            elif isinstance(stmt, ast.Match):
+                for case in stmt.cases:
+                    yield from self._walk_block(ctx, model, owned,
+                                                case.body, tries)
+            elif _TRY_STAR is not None and isinstance(stmt, _TRY_STAR):
+                inner = tries + ([stmt] if stmt.finalbody else [])
+                for blk in (stmt.body, *[h.body for h in stmt.handlers],
+                            stmt.orelse):
+                    yield from self._walk_block(ctx, model, owned, blk,
+                                                inner)
+                yield from self._walk_block(ctx, model, owned,
+                                            stmt.finalbody, tries)
+
+    @staticmethod
+    def _own_calls(stmt) -> List[ast.Call]:
+        """Calls belonging to THIS statement: the whole subtree for
+        simple statements, only the header expressions for compound
+        ones (their bodies are walked as blocks of their own).
+        ``iter_scope`` keeps nested defs/lambdas out — their bodies
+        run later, under whatever protection their caller sets up."""
+        if isinstance(stmt, _SIMPLE_STMTS):
+            roots: List[ast.AST] = [stmt]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            roots = [stmt.iter]
+        elif isinstance(stmt, (ast.While, ast.If)):
+            roots = [stmt.test]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            roots = [item.context_expr for item in stmt.items]
+        elif isinstance(stmt, ast.Match):
+            roots = [stmt.subject] + [c.guard for c in stmt.cases
+                                      if c.guard is not None]
+        else:
+            return []
+        out: List[ast.Call] = []
+        for root in roots:
+            for n in [root, *iter_scope(root)]:
+                if isinstance(n, ast.Call):
+                    out.append(n)
+        return out
+
+    @staticmethod
+    def _protected(model, resource, tries, nxt) -> bool:
+        for t in tries:
+            if model.releases_in(t.finalbody, resource):
+                return True
+        if isinstance(nxt, ast.Try) and nxt.finalbody \
+                and model.releases_in(nxt.finalbody, resource):
+            return True
+        return False
+
+
+# ============================================================= GL302
+@register
+class ErrorTaxonomyRule(Rule):
+    id = "GL302"
+    name = "error-taxonomy"
+    severity = "error"
+    description = ("wire/serving 4xx fed by a blanket except or "
+                   "selected by an isinstance test on an undeclared "
+                   "exception type — internal bugs must report 5xx, "
+                   "not hide as client errors (the PR-14 blanket-400 "
+                   "class)")
+
+    def check(self, ctx) -> Iterator:
+        if not _in_scope(ctx) or not ctx.is_wire:
+            return
+        declared = CLIENT_ERROR_TYPES | ctx.resources.client_errors
+        for fi in ctx.resources.funcs.values():
+            params = self._params(fi.node)
+            for n in iter_scope(fi.node):
+                if isinstance(n, ast.ExceptHandler):
+                    yield from self._check_handler(ctx, n)
+                elif isinstance(n, ast.If):
+                    yield from self._check_classifier(ctx, n, params,
+                                                      declared)
+
+    @staticmethod
+    def _params(func) -> Set[str]:
+        a = func.args
+        names = {x.arg for x in
+                 list(getattr(a, "posonlyargs", [])) + a.args
+                 + a.kwonlyargs}
+        for x in (a.vararg, a.kwarg):
+            if x is not None:
+                names.add(x.arg)
+        names.discard("self")
+        return names
+
+    # --- blanket except feeding 4xx ------------------------------------
+    def _check_handler(self, ctx, handler):
+        types_ = self._handler_types(handler)
+        if types_ is not None and not (types_ & _BLANKET):
+            return  # narrowly typed: wrapping at origin is blessed
+        for node, status in self._fourxx(handler.body):
+            caught = "/".join(sorted(types_)) if types_ else "bare"
+            yield self.violation(
+                ctx, node, f"{status} fed by a blanket `except "
+                f"{caught}` — an internal bug here would masquerade as "
+                "a client error and dodge the 5xx SLO and traceback "
+                "log; catch the SPECIFIC exception the guarded "
+                "operation raises (or raise a declared client-error "
+                "type at the parse site)")
+
+    @staticmethod
+    def _handler_types(handler) -> Optional[Set[str]]:
+        """Set of caught type names, or None for a bare ``except:``."""
+        t = handler.type
+        if t is None:
+            return None
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        out: Set[str] = set()
+        for e in elts:
+            seg = last_seg(e)
+            if seg:
+                out.add(seg)
+        return out
+
+    # --- isinstance classifier mapping to 4xx --------------------------
+    def _check_classifier(self, ctx, if_node, params, declared):
+        tested = self._isinstance_types(if_node.test, params)
+        if not tested:
+            return
+        undeclared = sorted(tested - declared)
+        if not undeclared:
+            return
+        for node, status in self._fourxx(if_node.body):
+            yield self.violation(
+                ctx, node, f"{status} selected by `isinstance` on "
+                f"{'/'.join(f'`{t}`' for t in undeclared)} — not a "
+                "declared client-error type (see the GL302 taxonomy "
+                "in tools/graftlint/README.md); raise a declared type "
+                "at the client-input site instead of widening the 4xx "
+                "mapping, or declare it with `# graftlint: "
+                "client-error=<Type>`")
+
+    @staticmethod
+    def _isinstance_types(test, params) -> Set[str]:
+        """Type names from ``isinstance(<param>, T | (T, ...))`` tests
+        anywhere in the If test expression."""
+        out: Set[str] = set()
+        for n in ast.walk(test):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "isinstance"
+                    and len(n.args) == 2):
+                continue
+            obj, typ = n.args
+            if not (isinstance(obj, ast.Name) and obj.id in params):
+                continue
+            elts = typ.elts if isinstance(typ, ast.Tuple) else [typ]
+            for e in elts:
+                seg = last_seg(e)
+                if seg:
+                    out.add(seg)
+        return out
+
+    # --- 4xx production detection --------------------------------------
+    @staticmethod
+    def _const_4xx(node) -> Optional[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and 400 <= node.value <= 499:
+            return node.value
+        return None
+
+    def _fourxx(self, body) -> List[Tuple[ast.AST, int]]:
+        out: List[Tuple[ast.AST, int]] = []
+        for stmt in body:
+            for n in [stmt, *iter_scope(stmt)]:
+                if isinstance(n, ast.Call):
+                    seg = _callee_name(n)
+                    if seg and (seg.endswith("HTTPError")
+                                or seg in _SENDERS) and n.args:
+                        status = self._const_4xx(n.args[0])
+                        if status is not None:
+                            out.append((n, status))
+                elif isinstance(n, ast.Return) and n.value is not None:
+                    v = n.value
+                    first = (v.elts[0] if isinstance(v, ast.Tuple)
+                             and v.elts else v)
+                    status = self._const_4xx(first)
+                    if status is not None:
+                        out.append((n, status))
+        return out
+
+
+# ============================================================= GL303
+@register
+class ReleaseOnAllPathsRule(Rule):
+    id = "GL303"
+    name = "release-on-all-paths"
+    severity = "error"
+    description = ("a tracked paired counter with acquire sites but no "
+                   "release site in the file, or an unannotated "
+                   "mutation of a tracked counter attribute — the "
+                   "wire_inflight/_probe_inflight inc/dec class")
+
+    def check(self, ctx) -> Iterator:
+        if not _in_scope(ctx):
+            return
+        model: resources.ResourceModel = ctx.resources
+        if not model.has_annotations():
+            return
+        yield from self._check_pairing(ctx, model)
+        yield from self._check_discipline(ctx, model)
+
+    def _check_pairing(self, ctx, model):
+        released: Set[str] = set()
+        for _line, toks in model.release_stmt_sites():
+            released |= toks
+        for toks in model.name_releases.values():
+            released |= toks
+        for line, toks in model.acquire_stmt_sites():
+            for r in sorted(toks - released):
+                fake = types.SimpleNamespace(lineno=line, col_offset=0)
+                yield self.violation(
+                    ctx, fake, f"resource `{r}` is acquired here but "
+                    "nothing in this file releases it — a one-way "
+                    "counter only ever leaks (the probe-slot class); "
+                    f"annotate the decrement `# releases: {r}` or "
+                    "remove the tracking if the resource is not paired")
+
+    def _check_discipline(self, ctx, model):
+        if not model.marked_attrs:
+            return
+        for fi in model.funcs.values():
+            if fi.name == "__init__":
+                continue  # construction happens-before sharing
+            for stmt in iter_scope(fi.node):
+                if not isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                         ast.AnnAssign, ast.Delete)):
+                    continue
+                attr = resources.ResourceModel._mutated_attr(stmt)
+                if attr is None:
+                    continue
+                key = (fi.class_name, attr)
+                if key not in model.marked_attrs:
+                    continue
+                if stmt.lineno in model.stmt_sites:
+                    continue
+                rs = "/".join(sorted(model.marked_attrs[key]))
+                yield self.violation(
+                    ctx, stmt, f"unannotated mutation of tracked "
+                    f"counter `self.{attr}` (resource {rs}) — every "
+                    "inc/dec of a paired counter must declare its side "
+                    "with `# acquires:` / `# releases:` so the pairing "
+                    "stays checkable; annotate this site or move the "
+                    "mutation into the annotated acquire/release "
+                    "methods")

@@ -49,7 +49,8 @@ import ast
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
-from tools.graftlint.tracing import FuncInfo, dotted, iter_scope, last_seg
+from tools.graftlint.tracing import (FuncInfo, collect_functions, dotted,
+                                     iter_scope, last_seg)
 
 # lock constructors, by reentrancy.  A default Condition() wraps an
 # RLock; Condition(lock) takes the wrapped lock's kind (and aliases it).
@@ -95,10 +96,10 @@ class ThreadModel:
         # class name -> attrs assigned threading.Thread(...) somewhere
         self.class_threads: Dict[str, Set[str]] = {}
 
-        # function index (same shape tracing uses)
-        self.funcs: Dict[int, FuncInfo] = {}
-        self.by_name: Dict[str, List[FuncInfo]] = {}
-        self._collect(tree, class_name=None, parent=None)
+        # function index (the shared tracing.collect_functions walker)
+        self.funcs: Dict[int, FuncInfo]
+        self.by_name: Dict[str, List[FuncInfo]]
+        self.funcs, self.by_name = collect_functions(tree)
 
         # annotations
         # (class name|None, attr/global name) -> (lock key, mode)
@@ -115,19 +116,6 @@ class ThreadModel:
         self._propagate_entries()
 
         self._held_cache: Dict[int, Dict[int, frozenset]] = {}
-
-    # ------------------------------------------------------------ indexing
-    def _collect(self, node, class_name, parent):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.ClassDef):
-                self._collect(child, class_name=child.name, parent=parent)
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                fi = FuncInfo(child, child.name, class_name, parent)
-                self.funcs[id(child)] = fi
-                self.by_name.setdefault(child.name, []).append(fi)
-                self._collect(child, class_name=class_name, parent=fi)
-            else:
-                self._collect(child, class_name=class_name, parent=parent)
 
     # ------------------------------------------------------- lock discovery
     @staticmethod

@@ -120,14 +120,46 @@ def iter_scope(node: ast.AST):
         stack.extend(ast.iter_child_nodes(n))
 
 
+def collect_functions(tree: ast.AST, on_class=None):
+    """The ONE function indexer behind the traced/thread/resource
+    models: ``(funcs by id(node), name -> [FuncInfo])`` with
+    nearest-enclosing class and function attribution, nested defs
+    included.  ``on_class(node)`` is called once per ClassDef (the
+    traced model records base names there)."""
+    funcs: Dict[int, FuncInfo] = {}
+    by_name: Dict[str, List[FuncInfo]] = {}
+
+    def walk(node, class_name, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                if on_class is not None:
+                    on_class(child)
+                walk(child, child.name, parent)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                fi = FuncInfo(child, child.name, class_name, parent)
+                funcs[id(child)] = fi
+                by_name.setdefault(child.name, []).append(fi)
+                walk(child, class_name, fi)
+            else:
+                walk(child, class_name, parent)
+
+    walk(tree, None, None)
+    return funcs, by_name
+
+
 class TracedModel:
     def __init__(self, tree: ast.Module, path: str):
         self.tree = tree
         self.path = path.replace("\\", "/")
-        self.funcs: Dict[int, FuncInfo] = {}
-        self.by_name: Dict[str, List[FuncInfo]] = {}
         self.class_bases: Dict[str, List[str]] = {}
-        self._collect(tree, class_name=None, parent=None)
+
+        def _bases(child):
+            self.class_bases[child.name] = [
+                s for s in (last_seg(b) for b in child.bases) if s]
+
+        self.funcs, self.by_name = collect_functions(tree,
+                                                     on_class=_bases)
         self.traced_ids: Set[int] = set()
         self.root_ids: Set[int] = set()
         self._mark_roots()
@@ -138,21 +170,6 @@ class TracedModel:
         # it only has to be right often enough to seed the taint pass)
         self._ret_tainted: Dict[str, bool] = {}
         self._compute_taints()
-
-    # ------------------------------------------------------------ collection
-    def _collect(self, node, class_name, parent):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.ClassDef):
-                self.class_bases[child.name] = [
-                    s for s in (last_seg(b) for b in child.bases) if s]
-                self._collect(child, class_name=child.name, parent=parent)
-            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                fi = FuncInfo(child, child.name, class_name, parent)
-                self.funcs[id(child)] = fi
-                self.by_name.setdefault(child.name, []).append(fi)
-                self._collect(child, class_name=class_name, parent=fi)
-            else:
-                self._collect(child, class_name=class_name, parent=parent)
 
     def _class_reaches(self, cls: Optional[str], targets: Set[str],
                        seen: Optional[Set[str]] = None) -> bool:
