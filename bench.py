@@ -1879,16 +1879,151 @@ def checkpoint_bench(smoke: bool = False):
     return out
 
 
+def elastic_child():
+    """``--elastic-child``: one elastic training run on an 8-device
+    virtual CPU mesh — world 4, a seeded ``resize@`` shrink to 2
+    mid-run, resume from the boundary snapshot with the ZeRO-1 state
+    re-sharded, then a regrow back to 4 (``bigdl_tpu.resilience.
+    membership``).  Prints the measured JSON: membership epochs,
+    ``resilience/resize_downtime_s`` / ``steps_lost_to_resize``
+    straight from the registry, and median per-step time split into
+    baseline (world 4) / degraded (world 2) / recovered (world 4)
+    segments, each segment dropping its boundary step so the restore +
+    recompile gap lands in the downtime number, not the throughput."""
+    import tempfile
+
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from bigdl_tpu import nn, optim
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.utils.config import configure, reset_config
+
+    smoke = os.environ.get("_BENCH_ELASTIC_SMOKE") == "1"
+    iters, shrink_at, regrow_at, every = \
+        (18, 6, 12, 2) if smoke else (60, 20, 40, 4)
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(0, 1, (64,)).astype(np.float32),
+                      np.int32(rng.integers(0, 10)))
+               for _ in range(2048)]
+
+    step_t = {}  # neval -> wall clock at replay (last write wins)
+
+    class _Summary:
+        def add_train_step(self, step, loss, lr, throughput):
+            step_t[step] = time.perf_counter()
+
+        def add_scalar(self, *a):
+            pass
+
+        def trigger_for(self, name):
+            return None
+
+    model = nn.Sequential(
+        nn.Linear(64, 256), nn.ReLU(), nn.Linear(256, 256), nn.ReLU(),
+        nn.Linear(256, 10), nn.LogSoftMax())
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    plan = f"resize@at={shrink_at},to=2;resize@at={regrow_at},to=4"
+    configure(fault_plan=plan)
+    try:
+        # snapshots live only for the run — repeated bench invocations
+        # must not accumulate orphaned checkpoint data in /tmp
+        with tempfile.TemporaryDirectory(prefix="bench_elastic_") as d:
+            opt = (optim.DistriOptimizer(
+                model, DataSet.array(samples) >> SampleToMiniBatch(32),
+                nn.ClassNLLCriterion(), mesh=mesh)
+                .set_optim_method(optim.SGD(learning_rate=0.05))
+                .set_seed(0)
+                .set_train_summary(_Summary())
+                .set_end_when(optim.max_iteration(iters)))
+            opt.set_checkpoint(d, optim.several_iteration(every))
+            t0 = time.perf_counter()
+            opt.optimize()  # zero aborted runs IS the acceptance shape
+            wall = time.perf_counter() - t0
+    finally:
+        reset_config()
+
+    def seg_ms(lo, hi):
+        # median inter-step ms over (lo, hi]; the boundary step lo+1
+        # is excluded so the restore/recompile gap stays out
+        ts = [step_t[s] for s in sorted(step_t) if lo + 1 < s <= hi]
+        if len(ts) < 2:
+            return None
+        deltas = sorted(b - a for a, b in zip(ts, ts[1:]))
+        return round(deltas[len(deltas) // 2] * 1e3, 2)
+
+    snap = opt.metrics.registry.snapshot()
+    hist = snap["histograms"].get("resilience/resize_downtime_s") or {}
+    baseline = seg_ms(0, shrink_at)
+    degraded = seg_ms(shrink_at, regrow_at)
+    recovered = seg_ms(regrow_at, iters)
+    return {
+        "config": f"mlp64x256x256x10/sgd/batch32/iters{iters}/"
+                  f"shrink4to2@{shrink_at}/regrow@{regrow_at}/"
+                  f"ckpt_every{every}",
+        "wall_s": round(wall, 3),
+        "iterations": int(opt.state["neval"]),
+        "membership_epoch": int(snap["gauges"].get(
+            "resilience/membership_epoch", 0)),
+        "worlds": [e.world for e in opt._membership.history()],
+        "resize_downtime_s": {
+            k: round(hist[k], 4) for k in ("count", "mean", "max", "sum")
+            if k in hist},
+        "steps_lost_to_resize": snap["counters"].get(
+            "resilience/steps_lost_to_resize", 0),
+        "step_ms": {"baseline_world4": baseline,
+                    "degraded_world2": degraded,
+                    "recovered_world4": recovered},
+        "recovered_throughput_ratio":
+            round(baseline / recovered, 3)
+            if baseline and recovered else None,
+        # end-of-run registry snapshot (telemetry round 2)
+        "telemetry": opt.metrics.registry.scalars(),
+    }
+
+
+def elastic_bench(smoke: bool = False):
+    """Elastic-training entry (``--elastic``, the ISSUE-16 rider): a
+    child on an 8-device virtual CPU mesh runs a full shrink/regrow
+    cycle (world 4 → 2 → 4 via seeded ``resize@`` clauses) and this
+    wrapper records the measured resize downtime, steps lost, and the
+    recovered-throughput ratio.  The correctness gates — bitwise
+    resume at the replay boundary, ``membership_epoch`` == 3, zero
+    aborted runs — live in ``tests/test_membership.py``; this entry
+    records the numbers (record-never-abort: a failed child is an
+    error string in the capture, never a crash)."""
+    out = {"metric": "elastic_resize_downtime_s", "unit": "seconds",
+           "toolchain": _toolchain()}
+    r = subprocess_run(
+        [sys.executable, __file__, "--elastic-child"],
+        env=_cpu_mesh_env(_BENCH_ELASTIC_SMOKE="1" if smoke else "0"),
+        parse=json.loads)
+    if not isinstance(r, dict):
+        out["error"] = "elastic child failed"
+        out["value"] = None
+        return out
+    out.update(r)
+    out["value"] = (r.get("resize_downtime_s") or {}).get("mean")
+    out["zero_aborted_runs"] = r.get("membership_epoch") == 3 \
+        and r.get("worlds") == [4, 2, 4]
+    return out
+
+
 if __name__ == "__main__":
     if "--scaling-child" in sys.argv:
         scaling_child()
     elif "--collective-child" in sys.argv:
         collective_child()
+    elif "--elastic-child" in sys.argv:
+        print(json.dumps(elastic_child()))
     elif "--serving" in sys.argv:
         print(json.dumps(serving_bench("--smoke" in sys.argv)))
     elif "--checkpoint" in sys.argv:
         print(json.dumps(checkpoint_bench("--smoke" in sys.argv)))
     elif "--resilience" in sys.argv:
         print(json.dumps(resilience_bench("--smoke" in sys.argv)))
+    elif "--elastic" in sys.argv:
+        print(json.dumps(elastic_bench("--smoke" in sys.argv)))
     else:
         main(sys.argv[1:])

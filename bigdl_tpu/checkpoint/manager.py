@@ -27,6 +27,7 @@ from __future__ import annotations
 import logging
 import os
 import re
+import threading
 import time
 from typing import List, Optional
 
@@ -74,6 +75,13 @@ class CheckpointManager:
         # snapshot when a trigger checkpoint just covered the same
         # iteration
         self.last_saved_step: Optional[int] = None
+        # GC pin: the step latest_valid() last returned is excluded
+        # from _gc until restore completes — a retention ring turning
+        # over during a slow (e.g. elastic) restore must not delete the
+        # snapshot mid-read.  _gc runs on the writer thread, the pin is
+        # taken on the driver/restore thread, hence the lock.
+        self._pin_lock = threading.Lock()
+        self._pinned_step: Optional[int] = None  # guarded-by: _pin_lock
         os.makedirs(directory, exist_ok=True)
 
     # --------------------------------------------------------- discovery
@@ -90,15 +98,24 @@ class CheckpointManager:
                 out.append(int(m.group(1)))
         return sorted(out)
 
+    # acquires: snapshot_pin
     def latest_valid(self, verify: bool = True) -> Optional[str]:
         """Newest snapshot that passes integrity verification; corrupt
         or torn candidates are logged and SKIPPED (never loaded) — the
         retry loop then resumes from the last good state instead of
-        crashing again on a bad file."""
+        crashing again on a bad file.
+
+        The returned snapshot is PINNED against ``keep_last`` GC until
+        :meth:`unpin` runs (``restore``/``restore_into`` release it on
+        every path, success or raise) — otherwise a retention ring
+        turning over during a slow restore could delete the snapshot
+        between this verify pass and the load."""
         for step in reversed(self.steps()):
             path = self.path_for(step)
             ok, detail = verify_snapshot(path) if verify else (True, "")
             if ok:
+                with self._pin_lock:
+                    self._pinned_step = step  # acquires: snapshot_pin
                 return path
             logger.warning("checkpoint discovery: skipping %s (%s)",
                            path, detail)
@@ -106,6 +123,12 @@ class CheckpointManager:
                 self._registry.counter(
                     "checkpoint/corrupt_skipped").inc()
         return None
+
+    # releases: snapshot_pin
+    def unpin(self) -> None:
+        """Release the :meth:`latest_valid` GC pin (idempotent)."""
+        with self._pin_lock:
+            self._pinned_step = None  # releases: snapshot_pin
 
     # -------------------------------------------------------------- save
     def mark_run_start(self) -> None:
@@ -190,6 +213,10 @@ class CheckpointManager:
         if self.keep_every:
             keep.update(s for s in steps
                         if s and s % self.keep_every == 0)
+        with self._pin_lock:
+            pinned = self._pinned_step
+        if pinned is not None:
+            keep.add(pinned)  # a restore is reading this snapshot
         for s in steps:
             if s not in keep:
                 try:
@@ -208,26 +235,42 @@ class CheckpointManager:
             self._writer.close(raise_errors=raise_errors)
 
     # ----------------------------------------------------------- restore
+    # acquires: snapshot_pin
     def restore(self, path: Optional[str] = None, *,
                 verified: bool = False) -> dict:
         """Load a snapshot blob (latest valid when ``path`` is None).
         ``verified=True``: the caller's path already came from
         :meth:`latest_valid`, whose streamed CRC pass covers the whole
         file — skip the second end-to-end read.  Raises SnapshotError
-        when nothing loadable exists."""
-        if path is None:
-            path = self.latest_valid()
+        when nothing loadable exists.
+
+        On success the snapshot stays pinned against GC (ownership of
+        the pin passes to the caller — ``restore_into`` releases it
+        once the state is applied); on ANY raise the pin is released
+        here, so a failed restore cannot wedge retention."""
+        try:
             if path is None:
-                raise SnapshotError(
-                    f"no valid checkpoint under {self.directory}")
-            verified = True
-        return load_snapshot(path, verify=not verified)
+                path = self.latest_valid()
+                if path is None:
+                    raise SnapshotError(
+                        f"no valid checkpoint under {self.directory}")
+                verified = True
+            return load_snapshot(path, verify=not verified)
+        except BaseException:
+            self.unpin()
+            raise
 
     def manifest(self, path: Optional[str] = None) -> Optional[dict]:
         if path is None:
-            path = self.latest_valid()
-            if path is None:
-                return None
+            try:
+                path = self.latest_valid()
+                if path is None:
+                    return None
+                return read_manifest(path)
+            finally:
+                # manifest inspection holds no blob afterwards — the
+                # discovery pin it took must not outlive the call
+                self.unpin()
         return read_manifest(path)
 
     def restore_into(self, optimizer, path: Optional[str] = None, *,
@@ -236,37 +279,45 @@ class CheckpointManager:
         Optimizer` so its next ``optimize()`` resumes mid-epoch
         EXACTLY: model params/state, optimizer state (validated against
         the saved schema at optimize() time), driver counters, RNG seed
-        and the dataset shuffle position.  Returns the blob."""
-        blob = self.restore(path, verified=verified)
-        manifest_schema = (blob.get("manifest") or {}).get("schema")
-        if manifest_schema is not None:
-            # architecture drift is checked BEFORE the snapshot's params
-            # overwrite the model (afterwards the drift is invisible —
-            # the restored params ARE the old architecture); grad_sync /
-            # bucket-plan drift is checked at optimize(), where the sync
-            # mode is resolved
-            from bigdl_tpu.checkpoint.schema import validate_schema
-            cur = getattr(optimizer, "_model_params_schema",
-                          lambda: None)()
-            if cur is not None:
-                validate_schema(
-                    {"params": manifest_schema.get("params")},
-                    {"params": cur}, source="restore_into")
-        optimizer.model._params = blob["params"]
-        optimizer.model._state = blob["model_state"]
-        optimizer._resume_opt_state = blob["opt_state"]
-        manifest = blob.get("manifest") or {}
-        optimizer._resume_schema = manifest.get("schema")
-        if blob["driver_state"]:
-            optimizer.set_state(blob["driver_state"])
-        run = blob.get("run") or {}
-        if run.get("seed") is not None:
-            optimizer.set_seed(int(run["seed"]))
-        pos = run.get("dataset_position")
-        restore_pos = getattr(optimizer.dataset, "restore_position", None)
-        if pos and restore_pos is not None:
-            restore_pos(pos)
-        return blob
+        and the dataset shuffle position.  Returns the blob.
+
+        The snapshot stays GC-pinned for the whole application (the
+        caller's ``latest_valid`` pin, or the one ``restore`` takes);
+        the ``finally`` releases it on every path, raise included."""
+        try:
+            blob = self.restore(path, verified=verified)
+            manifest_schema = (blob.get("manifest") or {}).get("schema")
+            if manifest_schema is not None:
+                # architecture drift is checked BEFORE the snapshot's
+                # params overwrite the model (afterwards the drift is
+                # invisible — the restored params ARE the old
+                # architecture); grad_sync / bucket-plan drift is
+                # checked at optimize(), where the sync mode is resolved
+                from bigdl_tpu.checkpoint.schema import validate_schema
+                cur = getattr(optimizer, "_model_params_schema",
+                              lambda: None)()
+                if cur is not None:
+                    validate_schema(
+                        {"params": manifest_schema.get("params")},
+                        {"params": cur}, source="restore_into")
+            optimizer.model._params = blob["params"]
+            optimizer.model._state = blob["model_state"]
+            optimizer._resume_opt_state = blob["opt_state"]
+            manifest = blob.get("manifest") or {}
+            optimizer._resume_schema = manifest.get("schema")
+            if blob["driver_state"]:
+                optimizer.set_state(blob["driver_state"])
+            run = blob.get("run") or {}
+            if run.get("seed") is not None:
+                optimizer.set_seed(int(run["seed"]))
+            pos = run.get("dataset_position")
+            restore_pos = getattr(optimizer.dataset, "restore_position",
+                                  None)
+            if pos and restore_pos is not None:
+                restore_pos(pos)
+            return blob
+        finally:
+            self.unpin()
 
 
 def _tree_bytes(tree) -> int:

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import hashlib
 import json
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 
 class SchemaMismatchError(ValueError):
@@ -45,12 +45,18 @@ def build_schema(params, *, grad_sync: bool = False,
                  bucket_sizes: Optional[List[int]] = None,
                  wire_dtype: Optional[str] = None,
                  n_shard: Optional[int] = None,
-                 optim_method: Optional[str] = None) -> dict:
-    """The schema dict a snapshot manifest carries (JSON-able)."""
+                 optim_method: Optional[str] = None,
+                 bucket_content: Optional[List[int]] = None) -> dict:
+    """The schema dict a snapshot manifest carries (JSON-able).
+    ``bucket_content`` is the UNPADDED element count per bucket — the
+    world-size-invariant layout that elastic resume compares when the
+    padded ``bucket_sizes`` are allowed to drift."""
     gs: dict = {"enabled": bool(grad_sync)}
     if grad_sync:
         gs.update(bucket_sizes=[int(s) for s in (bucket_sizes or [])],
                   wire_dtype=str(wire_dtype), n_shard=int(n_shard or 1))
+        if bucket_content is not None:
+            gs["bucket_content"] = [int(s) for s in bucket_content]
     return {
         "params": describe_params(params),
         "grad_sync": gs,
@@ -72,8 +78,16 @@ def _diff_section(lines: List[str], label: str, saved, current) -> None:
         lines.append(f"    + current:  {current}")
 
 
-def diff_schemas(saved: dict, current: dict) -> List[str]:
-    """Human-readable diff lines (empty = compatible)."""
+def diff_schemas(saved: dict, current: dict,
+                 elastic: bool = False) -> List[str]:
+    """Human-readable diff lines (empty = compatible).
+
+    ``elastic=True`` is the elastic-resume compatibility mode: the
+    padded ``bucket_sizes`` and ``n_shard`` are ALLOWED to differ (the
+    world size changed — that is the point), while everything that
+    defines logical model identity stays strict: params, optim_method,
+    wire_dtype, grad_sync.enabled, and — when both schemas record it —
+    the world-size-invariant ``bucket_content`` layout."""
     lines: List[str] = []
     _diff_section(lines, "optim_method", saved.get("optim_method"),
                   current.get("optim_method"))
@@ -82,7 +96,14 @@ def diff_schemas(saved: dict, current: dict) -> List[str]:
         _diff_section(lines, "grad_sync.enabled", sgs.get("enabled"),
                       cgs.get("enabled"))
     elif sgs.get("enabled"):
-        for k in ("bucket_sizes", "wire_dtype", "n_shard"):
+        keys = (("wire_dtype", "bucket_content") if elastic
+                else ("bucket_sizes", "wire_dtype", "n_shard"))
+        for k in keys:
+            if elastic and k == "bucket_content" \
+                    and (k not in sgs or k not in cgs):
+                # pre-elastic snapshots don't record content sizes;
+                # reshard_state's own structural checks still apply
+                continue
             _diff_section(lines, f"grad_sync.{k}", sgs.get(k), cgs.get(k))
     sp, cp = saved.get("params") or {}, current.get("params") or {}
     for key in sorted(set(sp) | set(cp)):
@@ -91,16 +112,31 @@ def diff_schemas(saved: dict, current: dict) -> List[str]:
     return lines
 
 
+def elastic_compatible(saved: Optional[dict],
+                       current: dict) -> Tuple[bool, List[str]]:
+    """Would an ELASTIC resume accept this snapshot?  Returns
+    ``(verdict, diff_lines)`` — the operator-facing form behind
+    ``tools.ckpt_inspect --schema``.  Legacy schema-less snapshots are
+    compatible-with-caveats (diff lines name the missing schema)."""
+    if saved is None:
+        return True, ["(legacy snapshot: no schema — structural "
+                      "checks apply at restore time)"]
+    lines = diff_schemas(saved, current, elastic=True)
+    return not lines, lines
+
+
 def validate_schema(saved: Optional[dict], current: dict,
-                    source: str = "checkpoint") -> None:
+                    source: str = "checkpoint",
+                    elastic: bool = False) -> None:
     """Raise :class:`SchemaMismatchError` with the full diff when the
     snapshot's schema and the current run's disagree.  ``saved=None``
     (a legacy pre-manifest snapshot) validates nothing — the structural
     fallback checks in ``DistriOptimizer._check_resumed_opt_state``
-    still apply."""
+    still apply.  ``elastic=True`` tolerates world-size/bucket-padding
+    drift (see :func:`diff_schemas`) instead of the hard refusal."""
     if saved is None:
         return
-    lines = diff_schemas(saved, current)
+    lines = diff_schemas(saved, current, elastic=elastic)
     if not lines:
         return
     hints = []
@@ -110,8 +146,16 @@ def validate_schema(saved: Optional[dict], current: dict,
         hints.append("resume with the matching grad_sync / "
                      "parameter_sharding setting")
     elif sgs.get("enabled") and sgs != cgs:
-        hints.append("the bucket plan drifted — restore the original "
-                     "mesh size / grad_bucket_bytes / grad_wire_dtype")
+        if elastic:
+            hints.append("the bucket CONTENT layout drifted — an "
+                         "elastic resume only tolerates world-size/"
+                         "padding changes, not grad_bucket_bytes or "
+                         "wire-dtype changes")
+        else:
+            hints.append("the bucket plan drifted — restore the "
+                         "original mesh size / grad_bucket_bytes / "
+                         "grad_wire_dtype (or resume elastically: "
+                         "world-size drift alone is resumable)")
     if (saved.get("params") or {}) != (current.get("params") or {}):
         hints.append("the model architecture changed since the "
                      "snapshot was written")

@@ -151,6 +151,35 @@ class DeviceBlockStager:
         return dev_xs, dev_ys, [b.size() for b in batches]
 
 
+def fast_forward_records(batch_iter, skip: int) -> int:
+    """Advance a fresh epoch iterator past exactly ``skip`` records
+    (the mid-epoch resume fast-forward).  Scale-aware callers divide
+    the GLOBAL records counter by their per-step record scale first —
+    under an elastic resume each of P′ survivors skips its own 1/P′
+    share through this one helper.
+
+    Raises a targeted error when the batch boundaries cannot land on
+    ``skip`` exactly: silently overshooting would replay the epoch
+    from a position the loss trajectory never visited."""
+    skipped = 0
+    while skipped < skip:
+        try:
+            skipped += next(batch_iter).size()
+        except StopIteration:
+            raise ValueError(
+                f"dataset fast-forward: epoch exhausted after "
+                f"{skipped} records while seeking {skip} — the "
+                f"dataset shrank since the snapshot was written"
+            ) from None
+    if skipped != skip:
+        raise ValueError(
+            f"dataset fast-forward: batch boundaries land on {skipped} "
+            f"records, not the {skip} the snapshot recorded — batch "
+            f"size or dataset layout changed since the snapshot was "
+            f"written")
+    return skipped
+
+
 def _stack(samples) -> MiniBatch:
     feats = np.stack([s.feature for s in samples])
     if samples[0].label is None:

@@ -59,6 +59,8 @@ from bigdl_tpu.engine import Engine
 from bigdl_tpu.optim.optimizer import (Optimizer, select_step,
                                        step_finite)
 from bigdl_tpu.parallel import grad_sync
+from bigdl_tpu.resilience.membership import (ClusterMembership,
+                                             MembershipChanged)
 from bigdl_tpu.resilience.numeric import NonFiniteStepError
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -215,6 +217,115 @@ class DistriOptimizer(Optimizer):
                     f"run's bucket plan {want} — mesh size or "
                     f"grad_bucket_bytes changed since the checkpoint "
                     f"was written")
+
+    # ------------------------------------------------- elastic membership
+    def set_elastic(self,
+                    membership: Optional[ClusterMembership] = None
+                    ) -> "DistriOptimizer":
+        """Arm elastic training: membership epochs over THIS mesh's
+        device pool.  A ``resize``/``host_loss``/``device_loss`` fault
+        clause (or an explicit ``request_resize`` on the returned
+        membership) opens a new epoch; the driver detects it at the
+        replay boundary, snapshots, and ``optimize()`` resumes on the
+        new roster with the ZeRO-1 state re-sharded.  Built ONCE per
+        optimizer — epochs stay monotonic across every shrink/regrow
+        cycle of one run (4 → 2 → 4 ends at epoch 3, not 1)."""
+        if self._membership is None:
+            self._membership = membership if membership is not None \
+                else ClusterMembership(
+                    tuple(self.mesh.devices.flat),
+                    registry=self.metrics.registry,
+                    recorder=getattr(self, "_flight", None))
+        return self
+
+    def _arm_membership_from_plan(self, faults) -> None:
+        if faults is None or not faults.has_membership_kinds():
+            return
+        self.set_elastic()
+
+    def _adopt_membership_roster(self) -> None:
+        """An epoch opened BETWEEN runs (operator ``request_resize``
+        before ``optimize()``): nothing is in flight, so adopt the
+        roster up front — no snapshot restore, no steps lost.  Must run
+        BEFORE any placement/sharding derives from ``self.mesh``;
+        without it the run would dispatch on the stale mesh while the
+        membership ledger says otherwise."""
+        m = self._membership
+        if m is None:
+            return
+        cur = m.current()
+        if tuple(cur.devices) == tuple(self.mesh.devices.flat):
+            return
+        self.mesh = Mesh(np.asarray(cur.devices), ("data",))
+        if self.model._params is not None:
+            # params may still be committed to the old roster's devices
+            # — pull them to host so this run's dispatch commits them
+            # to the adopted mesh (the restore path gets host arrays
+            # from the snapshot for free)
+            self.model._params = jax.device_get(self.model._params)
+            self.model._state = jax.device_get(self.model._state)
+        logger.warning(
+            "membership epoch %d (%s): adopting world=%d roster "
+            "at run start", cur.epoch, cur.reason, cur.world)
+        self._flight_event("resize_adopt", epoch=cur.epoch,
+                           world=cur.world, reason=cur.reason)
+
+    def _resume_after_resize(self, e: MembershipChanged) -> None:
+        """Rebuild the mesh on the new epoch's roster and restore the
+        latest valid snapshot so the next ``_optimize_impl`` resumes on
+        it (the grad_sync state is re-sharded there, where the new
+        bucket plan exists).  Called from ``optimize()``'s
+        :class:`MembershipChanged` handler — a resize is a measured
+        event, not a failure, so it never burns the retry budget."""
+        ep = e.epoch
+        self.mesh = Mesh(np.asarray(ep.devices), ("data",))
+        logger.warning(
+            "membership epoch %d (%s, graceful=%s): resuming on "
+            "world=%d", ep.epoch, ep.reason, ep.graceful, ep.world)
+        mgr = self._checkpoint_manager()
+        mgr.wait()
+        ckpt = mgr.latest_valid()
+        if ckpt is None:
+            raise RuntimeError(
+                f"membership epoch {ep.epoch} ({ep.reason}) but no "
+                f"valid snapshot under {self.checkpoint_path} to "
+                f"resume from — elastic training needs one committed "
+                f"snapshot before an abrupt device loss") from e
+        mgr.restore_into(self, ckpt, verified=True)
+        lost = max(0, e.detected_neval - int(self.state["neval"]))
+        self.metrics.registry.counter(
+            "resilience/steps_lost_to_resize").inc(lost)
+        self._flight_event("resize_restore", epoch=ep.epoch,
+                           world=ep.world, reason=ep.reason,
+                           steps_lost=lost,
+                           iteration=int(self.state["neval"]))
+        # downtime clock keeps running until the resumed driver stages
+        # its first block (observed there as resilience/resize_downtime_s)
+        self._resize_t0 = e.t0
+
+    def _maybe_reshard_resumed(self, ostate):
+        """Elastic resume of a grad_sync state written at a DIFFERENT
+        world size: strip the old per-shard padding, re-pad each flat
+        bucket to this run's plan (``grad_sync.reshard_state`` —
+        padding is zeros and elementwise optimizers map zeros to zeros,
+        so the re-bucketing is information-preserving).  Non-elastic
+        runs fall through to ``_check_resumed_opt_state``'s hard
+        refusal unchanged."""
+        if self._membership is None or not self._use_grad_sync:
+            return ostate
+        is_gs = (isinstance(ostate, dict) and set(ostate) ==
+                 {"master", "opt"} and isinstance(ostate.get("master"),
+                                                  list))
+        if not is_gs:
+            return ostate
+        want = [(s,) for s in self._gs_plan.bucket_sizes]
+        got = [tuple(np.shape(m)) for m in ostate["master"]]
+        if want == got:
+            return ostate
+        logger.info(
+            "elastic resume: re-sharding grad_sync state %s -> %s "
+            "(n_shard=%d)", got, want, self._gs_plan.n_shard)
+        return grad_sync.reshard_state(self._gs_plan, ostate)
 
     def _build_block_fn(self, grad_fn, k: int):
         """grad_sync runs: ONE donated jit whose body is a ``shard_map``
@@ -424,7 +535,8 @@ class DistriOptimizer(Optimizer):
             bucket_sizes=self._gs_plan.bucket_sizes,
             wire_dtype=jnp.dtype(self._gs_wire).name,
             n_shard=self._gs_plan.n_shard,
-            optim_method=type(self.optim_method).__name__)
+            optim_method=type(self.optim_method).__name__,
+            bucket_content=grad_sync.bucket_content_sizes(self._gs_plan))
 
     # ------------------------------------------------------------- train
     def optimize(self):
@@ -432,6 +544,13 @@ class DistriOptimizer(Optimizer):
         while True:
             try:
                 return self._optimize_impl()
+            except MembershipChanged as e:
+                # elastic resize: the driver already replayed/abandoned
+                # the in-flight block and secured a boundary snapshot —
+                # rebuild the mesh on the new roster, restore, and go
+                # again.  A measured event, not a failure: the retry
+                # budget is untouched.
+                self._resume_after_resize(e)
             except NonFiniteStepError as e:
                 # numeric_guard: "abort" must surface at the exact
                 # iteration — the one failure class the reference-style
@@ -469,6 +588,7 @@ class DistriOptimizer(Optimizer):
                 mgr.restore_into(self, ckpt, verified=True)
 
     def _optimize_impl(self):
+        self._adopt_membership_roster()
         mesh = self.mesh
         self._n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
         rng = jax.random.PRNGKey(self.seed)
@@ -486,6 +606,7 @@ class DistriOptimizer(Optimizer):
         if self._resume_opt_state is not None:
             ostate = self._resume_opt_state
             self._resume_opt_state = None
+            ostate = self._maybe_reshard_resumed(ostate)
             self._check_resumed_opt_state(ostate)
         elif self._use_grad_sync:
             ostate = grad_sync.init_state(self._gs_plan, params,

@@ -68,6 +68,8 @@ from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 from bigdl_tpu.checkpoint import (CheckpointManager, PreemptionHandler,
                                   build_schema, validate_schema)
 from bigdl_tpu.resilience.faults import FaultInjector, InjectedFault
+from bigdl_tpu.resilience.membership import (ClusterMembership,
+                                             MembershipChanged)
 from bigdl_tpu.resilience.numeric import (NonFiniteStepError,
                                           validate_policy)
 from bigdl_tpu.telemetry import DriverTelemetry, NULL_SPAN, jit_cache_size
@@ -235,6 +237,16 @@ class Optimizer:
         self._dispatch_count = 0  # jit dispatches issued (observability)
         self._stager: Optional[DeviceBlockStager] = None
         self._epoch_size = 0
+        # elastic training (bigdl_tpu/resilience/membership): None —
+        # the provably-inert state — unless a membership fault clause
+        # or DistriOptimizer.set_elastic() arms one.  Every membership
+        # site below guards on that, so a plan-free run builds no
+        # membership object and no roster check.
+        self._membership: Optional[ClusterMembership] = None
+        # monotonic() timestamp of the last MembershipChanged detection
+        # — the resumed run observes resilience/resize_downtime_s from
+        # it once the driver is staging again
+        self._resize_t0: Optional[float] = None
 
     # ------------------------------------------------------------- builder
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -589,12 +601,25 @@ class Optimizer:
         replay adds ``n_local * scale``); the iterator here yields this
         host's LOCAL batches, so the skip budget is the global count
         divided back by the records scale (process_count under
-        multi-host SPMD — every host skips its own 1/P share)."""
+        multi-host SPMD — every host skips its own 1/P share).  The
+        counter must divide EVENLY: under an elastic resume P may have
+        changed since the snapshot, and a remainder means this host's
+        share is not expressible in whole records — silently flooring
+        would mis-position the dataset (the PR-7 fix assumed a
+        constant P)."""
         scale = max(1, self._records_scale())
-        skip = state.get("records_processed_this_epoch", 0) // scale
-        skipped = 0
-        while skipped < skip:
-            skipped += next(data_iter).size()
+        rec = state.get("records_processed_this_epoch", 0)
+        if rec % scale:
+            raise ValueError(
+                f"mid-epoch resume: the snapshot's global records "
+                f"counter ({rec}) does not divide by this run's records "
+                f"scale ({scale}) — the world size/process count "
+                f"changed since the snapshot was written and the "
+                f"per-host skip would mis-position the dataset; resume "
+                f"at a compatible scale or from an epoch boundary")
+        skip = rec // scale
+        from bigdl_tpu.dataset.prefetch import fast_forward_records
+        skipped = fast_forward_records(data_iter, skip)
         if skipped:
             logger.info("resume: skipped %d already-processed local "
                         "records (of %d global)", skipped, skip * scale)
@@ -659,10 +684,40 @@ class Optimizer:
     def _validate_resume_schema(self, params) -> None:
         """Diff the restored snapshot's schema against this run —
         grad_sync flips, bucket-plan drift, and architecture drift fail
-        loudly here instead of as a jit structure error."""
+        loudly here instead of as a jit structure error.  An elastic
+        run validates in elastic-compat mode: world-size/bucket-padding
+        drift is the point, logical identity stays strict."""
         saved, self._resume_schema = self._resume_schema, None
         if saved is not None:
-            validate_schema(saved, self._checkpoint_schema(params))
+            validate_schema(saved, self._checkpoint_schema(params),
+                            elastic=self._membership is not None)
+
+    def _arm_membership_from_plan(self, faults) -> None:
+        """Arm the membership layer when the fault plan carries
+        ``resize``/``host_loss``/``device_loss`` clauses.  The base
+        (single-device) trainer cannot resize — membership clauses in
+        its plan are a configuration error, refused loudly instead of
+        silently never firing.  DistriOptimizer overrides with the real
+        arming (mesh roster → ClusterMembership)."""
+        if faults is None or not faults.has_membership_kinds():
+            return
+        raise ValueError(
+            "fault plan contains membership kinds (resize/host_loss/"
+            "device_loss) but this is a LocalOptimizer — elastic "
+            "training needs DistriOptimizer's device mesh to resize "
+            "over")
+
+    def _apply_membership_clause(self, clause) -> None:
+        """Translate one fired membership fault clause into the
+        corresponding ClusterMembership signal (the injector stays free
+        of roster knowledge)."""
+        m = self._membership
+        if clause.kind == "resize":
+            m.request_resize(clause.to)
+        elif clause.kind == "host_loss":
+            m.signal_host_loss(to=clause.to)
+        else:  # device_loss
+            m.signal_device_loss(to=clause.to)
 
     def _maybe_checkpoint(self, params, mstate, ostate):
         if self.checkpoint_trigger and self.checkpoint_path \
@@ -909,6 +964,20 @@ class Optimizer:
             logger.warning("fault injection live: %s",
                            self._fault_injector.describe())
         faults = self._fault_injector
+        # elastic membership: armed only when the plan carries
+        # membership kinds or set_elastic() was called — otherwise
+        # self._membership stays None and every site below is inert
+        self._arm_membership_from_plan(faults)
+        membership = self._membership
+        if membership is not None and not self.checkpoint_path:
+            raise ValueError(
+                "elastic training (membership fault kinds / "
+                "set_elastic) needs set_checkpoint(path, trigger) — a "
+                "resize resumes from the latest valid snapshot")
+        # the epoch this driver run dispatches under; the loop compares
+        # it against the live epoch at the replay boundary it already
+        # crosses — detection costs zero additional host syncs
+        run_epoch = membership.epoch() if membership is not None else 0
         # checkpointing: manager built up front so the stall-fraction
         # denominator starts at the run, and preemption (SIGTERM/SIGINT
         # → finish block + final snapshot + clean return) has somewhere
@@ -931,6 +1000,18 @@ class Optimizer:
         stager = DeviceBlockStager(data_iter, self._place_train_block,
                                    tracer=tel.tracer if tel else None)
         self._stager = stager
+        if self._resize_t0 is not None:
+            # this run is the elastic resume: the driver is about to
+            # stage again — the detection→here window is the measured
+            # resize downtime
+            downtime = time.monotonic() - self._resize_t0
+            self._resize_t0 = None
+            self.metrics.registry.histogram(
+                "resilience/resize_downtime_s").observe(downtime)
+            self._flight_event("resize_resumed",
+                               downtime_s=round(downtime, 4),
+                               iteration=state["neval"],
+                               epoch=run_epoch)
         # the Parameters-histogram summary trigger is probed too: its
         # firing iteration must end a sync block so the histogram sees
         # exactly that iteration's params, not the end-of-block binding
@@ -1033,6 +1114,45 @@ class Optimizer:
                                             sync=True)
                     state["preempted"] = True
                     break
+                if membership is not None:
+                    changed = membership.changed_since(run_epoch)
+                    if changed is not None:
+                        # resize-on-preemption, riding the replay
+                        # boundary the loop already crossed: graceful
+                        # changes (resize request / preemption warning)
+                        # finish the in-flight block and write a final
+                        # synchronous snapshot (PR-7 semantics, zero
+                        # steps lost); abrupt device loss abandons it —
+                        # the device buffers are gone by assumption —
+                        # and the resume pays the steps since the last
+                        # snapshot.  The planned-ahead `staged` block is
+                        # discarded either way; its batches re-derive
+                        # from the saved records counter.
+                        t_detect = time.monotonic()
+                        if changed.graceful:
+                            if pending is not None:
+                                self._replay_block(pending, params,
+                                                   mstate, ostate)
+                                pending = None
+                            mgr.wait()  # writer idle → no racing GC
+                            if mgr.last_saved_step != state["neval"]:
+                                self._do_checkpoint(params, mstate,
+                                                    ostate, sync=True)
+                        else:
+                            pending = None
+                        logger.warning(
+                            "membership epoch %d (world %d, %s): "
+                            "suspending at iteration %d for elastic "
+                            "resume", changed.epoch, changed.world,
+                            changed.reason, state["neval"])
+                        self._flight_event(
+                            "membership_change", epoch=changed.epoch,
+                            world=changed.world, reason=changed.reason,
+                            graceful=changed.graceful,
+                            iteration=state["neval"])
+                        raise MembershipChanged(
+                            changed, changed.graceful, state["neval"],
+                            t_detect)
                 if staged is None:
                     if pending is None and self.end_when(state):
                         break
@@ -1100,14 +1220,18 @@ class Optimizer:
         finally:
             run_failing = sys.exc_info()[0] is not None
             if run_failing:
-                # the black box's raison d'être: the crash is on disk
-                # (the recorder flushes per event) even if nothing
-                # below gets to run
                 etype = sys.exc_info()[0]
-                self._flight_event("run_crash",
-                                   error=getattr(etype, "__name__",
-                                                 str(etype)),
-                                   iteration=state["neval"])
+                if not (isinstance(etype, type)
+                        and issubclass(etype, MembershipChanged)):
+                    # the black box's raison d'être: the crash is on
+                    # disk (the recorder flushes per event) even if
+                    # nothing below gets to run.  A membership change
+                    # is a measured event, not a crash — it already
+                    # recorded membership_change above.
+                    self._flight_event("run_crash",
+                                       error=getattr(etype, "__name__",
+                                                     str(etype)),
+                                       iteration=state["neval"])
             if preempt is not None:
                 preempt.uninstall()
             if tel is not None:
@@ -1278,6 +1402,16 @@ class Optimizer:
                 self._run_validation(params, mstate)
                 self._maybe_checkpoint(params, mstate, ostate)
                 state["epoch_finished"] = False
+                if self._fault_injector is not None \
+                        and self._membership is not None:
+                    # membership fault site (resize/host_loss/
+                    # device_loss clauses, keyed by the same 0-based
+                    # global iteration number as the batch kinds) —
+                    # the signal lands here; the driver loop detects
+                    # the epoch change at its next replay boundary
+                    for clause in self._fault_injector \
+                            .membership_events(state["neval"] - 1):
+                        self._apply_membership_clause(clause)
                 if self.end_when(state):
                     ended = True
                     break

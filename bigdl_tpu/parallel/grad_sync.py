@@ -199,6 +199,60 @@ def init_state(plan: BucketPlan, params, optim_method) -> dict:
     return {"master": masters, "opt": inner}
 
 
+def bucket_content_sizes(plan: BucketPlan) -> List[int]:
+    """Unpadded element count of each bucket — a pure function of the
+    param tree and ``grad_bucket_bytes``, INVARIANT under the world
+    size (only the tail padding divides by ``n_shard``).  This is the
+    quantity elastic resume compares across snapshots: two plans with
+    equal content layouts hold the same logical values, however they
+    were padded."""
+    return [sum(plan.leaf_meta[i][1] for i in idxs)
+            for idxs in plan.buckets]
+
+
+def reshard_state(plan: BucketPlan, gs_state: dict) -> dict:
+    """Re-pad a grad_sync optimizer state for a NEW world size
+    (elastic resume).  Runs on the host against the freshly-restored
+    state: every array leaf of ``gs_state`` is a padded flat bucket
+    (masters and elementwise inner state alike — ``init_state``
+    enforces the mirror), identified by the trailing list index of its
+    tree path.  Padding carries no information (``flatten_to_buckets``
+    zero-fills, elementwise optimizers map zeros to zeros), so
+    resharding is: slice each bucket to its content, re-pad with zeros
+    to ``plan.bucket_sizes``.  Gradient sums are world-size-invariant,
+    making the resharded trajectory exact at the replay boundary."""
+    content = bucket_content_sizes(plan)
+
+    def _bucket_ix(path) -> int:
+        for entry in reversed(path):
+            if isinstance(entry, jax.tree_util.SequenceKey):
+                return entry.idx
+        key = jax.tree_util.keystr(path)
+        raise ValueError(
+            f"grad_sync reshard: state leaf at {key} has no bucket "
+            f"index — not a grad_sync state layout")
+
+    def _repad(path, leaf):
+        b = _bucket_ix(path)
+        if b >= len(content):
+            raise ValueError(
+                f"grad_sync reshard: state has a bucket #{b} but the "
+                f"new plan only has {plan.num_buckets} — param tree or "
+                f"grad_bucket_bytes changed, not just the world size")
+        arr = np.asarray(leaf)
+        if arr.ndim != 1 or arr.shape[0] < content[b]:
+            raise ValueError(
+                f"grad_sync reshard: bucket #{b} holds "
+                f"{arr.shape} elements but the plan needs "
+                f"{content[b]} — param tree or grad_bucket_bytes "
+                f"changed, not just the world size")
+        out = np.zeros((plan.bucket_sizes[b],), dtype=arr.dtype)
+        out[:content[b]] = arr[:content[b]]
+        return out
+
+    return jax.tree_util.tree_map_with_path(_repad, gs_state)
+
+
 def wire_cast(x, wire_dtype, key, n_sum: int = 1):
     """Downcast one bucket to the wire dtype with the shared unbiased
     rounding (no-op for the f32 wire).  The f16 wire SATURATES first:

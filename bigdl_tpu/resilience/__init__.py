@@ -11,10 +11,15 @@ degradation path is gated by a deterministic test):
   shedding with retry-after;
 - :mod:`~bigdl_tpu.resilience.numeric` — the training driver's
   non-finite loss/grad guard policies (``skip`` | ``rollback`` |
-  ``abort``) riding the one-block-behind fetch.
+  ``abort``) riding the one-block-behind fetch;
+- :mod:`~bigdl_tpu.resilience.membership` — monotonic membership
+  epochs under elastic training: each epoch freezes a device roster,
+  and the driver detects roster changes at the replay boundary it
+  already crosses.
 
 ``ReplicaSet`` is imported lazily (PEP 562) so training-only processes
-never pay the serving import.
+never pay the serving import; the membership layer is lazy for the
+same reason (it only exists on elastic runs).
 """
 
 from bigdl_tpu.resilience.faults import (FaultClause, FaultInjector,
@@ -30,15 +35,21 @@ __all__ = [
     "FaultClause", "FaultInjector", "InjectedFault", "ReplicaDeathFault",
     "parse_fault_plan", "CircuitBreaker", "HealthPolicy", "ReplicaHealth",
     "NUMERIC_POLICIES", "NonFiniteStepError", "ReplicaSet",
-    "ReplicaDeadError",
+    "ReplicaDeadError", "ClusterMembership", "MembershipChanged",
+    "MembershipEpoch",
 ]
 
 _LAZY = {"ReplicaSet", "ReplicaDeadError"}
+_LAZY_MEMBERSHIP = {"ClusterMembership", "MembershipChanged",
+                    "MembershipEpoch"}
 
 
 def __getattr__(name):
     if name in _LAZY:
         from bigdl_tpu.resilience import replica_set
         return getattr(replica_set, name)
+    if name in _LAZY_MEMBERSHIP:
+        from bigdl_tpu.resilience import membership
+        return getattr(membership, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

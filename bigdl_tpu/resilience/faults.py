@@ -39,14 +39,23 @@ Plan grammar (``Config.fault_plan`` / ``BIGDL_TPU_FAULT_PLAN``)::
             | corrupt_batch     -- NaN-poison the staged training batch
             | nonfinite_grads   -- Inf-poison the staged training batch
                                    (overflows forward/backward)
-    keys   := at | after | until | every | count | target | p | ms
+            | resize            -- open a graceful membership epoch
+                                   shrinking/regrowing the world to to=
+            | host_loss         -- preemption warning: graceful shrink
+                                   (default to= half the world)
+            | device_loss       -- abrupt device loss: shrink with the
+                                   in-flight block abandoned
+                                   (default to= world - 1)
+    keys   := at | after | until | every | count | target | p | ms | to
             | where (serving|driver — dispatch_* kinds only;
                      default serving)
 
 Event indices: serving clauses fire on a replica's own dispatch counter;
 driver ``dispatch_*@where=driver`` clauses fire on the driver's dispatch
-counter; batch kinds fire on the global iteration number (so
-``corrupt_batch@at=7`` poisons exactly iteration 7's microbatch).
+counter; batch kinds AND membership kinds fire on the global iteration
+number (so ``corrupt_batch@at=7`` poisons exactly iteration 7's
+microbatch, and ``resize@at=7,to=2`` opens the shrink epoch the moment
+step 7 is replayed).
 """
 
 from __future__ import annotations
@@ -74,9 +83,10 @@ class ReplicaDeathFault(BaseException):
 
 _SERVING_KINDS = ("dispatch_error", "dispatch_delay", "replica_death")
 _BATCH_KINDS = ("corrupt_batch", "nonfinite_grads")
-KINDS = _SERVING_KINDS + _BATCH_KINDS
+_MEMBERSHIP_KINDS = ("resize", "host_loss", "device_loss")
+KINDS = _SERVING_KINDS + _BATCH_KINDS + _MEMBERSHIP_KINDS
 
-_INT_KEYS = ("at", "after", "until", "every", "count", "target")
+_INT_KEYS = ("at", "after", "until", "every", "count", "target", "to")
 _FLOAT_KEYS = ("p", "ms")
 _STR_KEYS = ("where",)
 
@@ -86,7 +96,7 @@ class FaultClause:
     counter — host-side state, serialized by the injector lock."""
 
     __slots__ = ("kind", "at", "after", "until", "every", "count",
-                 "target", "p", "ms", "where", "fired")
+                 "target", "p", "ms", "to", "where", "fired")
 
     def __init__(self, kind: str, **keys):
         if kind not in KINDS:
@@ -101,6 +111,7 @@ class FaultClause:
         self.target = keys.pop("target", None)
         self.p = float(keys.pop("p", 1.0))
         self.ms = float(keys.pop("ms", 10.0))
+        self.to = keys.pop("to", None)
         self.where = keys.pop("where", "serving")
         self.fired = 0
         if keys:
@@ -110,8 +121,24 @@ class FaultClause:
         if self.where not in ("serving", "driver"):
             raise ValueError(
                 f"where= must be serving|driver, got {self.where!r}")
-        if kind in _BATCH_KINDS and self.where == "serving":
-            self.where = "driver"  # batch kinds only exist in the driver
+        if kind in _BATCH_KINDS + _MEMBERSHIP_KINDS \
+                and self.where == "serving":
+            # batch and membership kinds only exist in the driver
+            self.where = "driver"
+        if self.to is not None and kind not in _MEMBERSHIP_KINDS:
+            raise ValueError(
+                f"to= only applies to membership kinds "
+                f"{_MEMBERSHIP_KINDS}, not {kind!r}")
+        if kind == "resize" and (self.to is None or self.to < 1):
+            raise ValueError(
+                "resize needs an explicit target world: to=<n> >= 1")
+        if kind in _MEMBERSHIP_KINDS and self.count is None:
+            # one membership event per clause unless asked otherwise:
+            # an elastic restore REWINDS the step counter, and a
+            # budget-less at= clause would re-fire on every replay
+            # crossing (a default-to device_loss would then shrink the
+            # roster again each pass — a runaway)
+            self.count = 1
         if not (0.0 <= self.p <= 1.0):
             raise ValueError(f"p= must be in [0, 1], got {self.p}")
         if self.every is not None and self.every < 1:
@@ -275,6 +302,21 @@ class FaultInjector:
         iteration number.  Returns the poison kinds firing at ``step``."""
         return [c.kind
                 for c in self._firing(_BATCH_KINDS, "driver", step)]
+
+    def has_membership_kinds(self) -> bool:
+        """Whether the plan contains any ``resize``/``host_loss``/
+        ``device_loss`` clause — the driver arms a
+        :class:`~bigdl_tpu.resilience.membership.ClusterMembership`
+        only then (plan without them stays membership-free)."""
+        return any(c.kind in _MEMBERSHIP_KINDS for c in self.clauses)
+
+    def membership_events(self, step: int) -> List[FaultClause]:
+        """Site: the driver's replayed iteration, keyed by the global
+        iteration number.  Returns the membership clauses firing at
+        ``step`` (the driver translates them into
+        ``ClusterMembership`` signals — this module stays free of any
+        roster knowledge)."""
+        return self._firing(_MEMBERSHIP_KINDS, "driver", step)
 
     def corrupt_staged(self, xs, first_step: int, k: int):
         """Poison the float leaves of a staged K-step block for every

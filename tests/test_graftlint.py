@@ -2135,6 +2135,8 @@ class TestRealTree:
              "_inflight"),
             ("bigdl_tpu/resilience/health.py", "ReplicaHealth",
              "_probe_inflight"),
+            ("bigdl_tpu/resilience/membership.py", "ClusterMembership",
+             "_epochs"),
             ("bigdl_tpu/telemetry/registry.py", "MetricRegistry",
              "_metrics"),
             ("bigdl_tpu/telemetry/tracer.py", "Tracer", "_events"),
@@ -2166,6 +2168,11 @@ class TestRealTree:
                                                     set(), set()),
             "bigdl_tpu/serving/registry.py": ("deploy_reservation",
                                               set(), set()),
+            # ISSUE-16 satellite: the latest_valid() GC pin must hold
+            # until restore_into finishes applying the snapshot
+            "bigdl_tpu/checkpoint/manager.py": (
+                "snapshot_pin", {"latest_valid", "restore"},
+                {"unpin"}),
         }
         for rel, (res, acq_defs, rel_defs) in sorted(expect.items()):
             src = open(os.path.join(REPO, rel)).read()

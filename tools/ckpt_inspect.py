@@ -13,10 +13,21 @@ Usage::
     python -m tools.ckpt_inspect ckpt_dir/model.120   # one snapshot
     python -m tools.ckpt_inspect ckpt_dir --json
     python -m tools.ckpt_inspect ckpt_dir --no-verify # manifest only
+    python -m tools.ckpt_inspect ckpt_dir --schema    # elastic audit
 
-Exit codes: 0 = every inspected snapshot is intact, 1 = at least one is
-corrupt/torn (the latest VALID one is still named so an operator knows
-what a resume would pick), 2 = nothing inspectable at the given path.
+``--schema`` is the elastic-training audit: per snapshot it prints the
+recorded world size, the ZeRO-1 bucket layout (padded sizes and the
+world-size-invariant unpadded content), and the wire dtype, then
+renders each snapshot's ELASTIC verdict against the newest
+schema-bearing one — would a resume that tolerates world-size drift
+(``schema.elastic_compatible``) accept it?  Exit 0 when every snapshot
+is elastic-resumable, 1 when any is incompatible (or corrupt).
+
+Exit codes: 0 = every inspected snapshot is intact (and, under
+``--schema``, elastic-resumable), 1 = at least one is corrupt/torn or
+elastic-incompatible (the latest VALID one is still named so an
+operator knows what a resume would pick), 2 = nothing inspectable at
+the given path.
 """
 
 from __future__ import annotations
@@ -31,9 +42,11 @@ from bigdl_tpu.checkpoint.snapshot import (SnapshotError, read_manifest,
                                            verify_snapshot)
 
 
-def inspect_snapshot(path: str, verify: bool = True) -> dict:
+def inspect_snapshot(path: str, verify: bool = True,
+                     with_schema: bool = False) -> dict:
     """One snapshot → report row (never raises for a corrupt file —
-    the corruption IS the finding)."""
+    the corruption IS the finding).  ``with_schema`` embeds the full
+    recorded schema dict for the ``--schema`` elastic audit."""
     row: dict = {"path": path, "size_bytes": None, "status": "ok"}
     try:
         row["size_bytes"] = os.path.getsize(path)
@@ -47,8 +60,12 @@ def inspect_snapshot(path: str, verify: bool = True) -> dict:
         row.update(status="legacy", format="v2 (no manifest)",
                    detail="pre-manifest checkpoint — integrity "
                           "unverifiable without loading")
+        if with_schema:
+            row["schema"] = None
         return row
     schema = manifest.get("schema") or {}
+    if with_schema:
+        row["schema"] = manifest.get("schema")
     gs = schema.get("grad_sync") or {}
     row.update(
         format=f"{manifest.get('format')} v{manifest.get('version')}",
@@ -123,6 +140,86 @@ def _render(rows: List[dict], latest_valid: Optional[str]) -> str:
     return "\n".join(lines)
 
 
+def schema_audit(rows: List[dict]) -> dict:
+    """The ``--schema`` elastic verdicts: every snapshot's recorded
+    schema against the NEWEST schema-bearing intact one (what a resume
+    would continue with).  ``compatible`` is the overall exit-0/1
+    verdict — True only when every intact snapshot is acceptable to an
+    elastic resume (``schema.elastic_compatible``: world-size/padding
+    drift tolerated, logical model identity strict)."""
+    from bigdl_tpu.checkpoint.schema import elastic_compatible, schema_hash
+    bearing = [r for r in rows
+               if r["status"] == "ok" and r.get("schema") is not None]
+    ref = bearing[-1] if bearing else None
+    verdicts = []
+    compatible = True
+    for r in rows:
+        if r["status"] in ("corrupt", "unreadable"):
+            verdicts.append({"path": r["path"], "verdict": "corrupt",
+                             "lines": [r.get("detail", "")]})
+            compatible = False
+            continue
+        if ref is None:
+            verdicts.append({"path": r["path"], "verdict": "no-reference",
+                             "lines": ["(no intact schema-bearing "
+                                       "snapshot to compare against)"]})
+            continue
+        if r is ref:
+            verdicts.append({"path": r["path"], "verdict": "reference",
+                             "lines": []})
+            continue
+        ok, lines = elastic_compatible(r.get("schema"), ref["schema"])
+        if not ok:
+            verdict = "INCOMPATIBLE"
+            compatible = False
+        elif r.get("schema") is not None and schema_hash(r["schema"]) \
+                == schema_hash(ref["schema"]):
+            verdict = "identical"
+        else:
+            verdict = "elastic-resumable"
+        verdicts.append({"path": r["path"], "verdict": verdict,
+                         "lines": lines})
+    return {"reference": ref["path"] if ref else None,
+            "verdicts": verdicts, "compatible": compatible}
+
+
+def _schema_line(r: dict) -> str:
+    schema = r.get("schema") or {}
+    gs = schema.get("grad_sync") or {}
+    if not gs.get("enabled"):
+        return (f"  step {r.get('step')}  world -  grad_sync off  "
+                f"({r.get('param_leaves')} param leaves, "
+                f"{schema.get('optim_method')})")
+    sizes = gs.get("bucket_sizes", [])
+    content = gs.get("bucket_content")
+    layout = f"buckets {sizes}" + (f" (content {content} unpadded)"
+                                   if content is not None else "")
+    return (f"  step {r.get('step')}  world {gs.get('n_shard')}  "
+            f"wire {gs.get('wire_dtype')}  {layout}")
+
+
+def _render_schema(rows: List[dict], audit: dict,
+                   latest_valid: Optional[str]) -> str:
+    by_path = {v["path"]: v for v in audit["verdicts"]}
+    lines = []
+    for r in rows:
+        lines.append(f"{r['path']}  [{r['status']}]")
+        if r["status"] in ("corrupt", "unreadable"):
+            lines.append(f"  {r.get('detail', '')}")
+            continue
+        if r["status"] == "legacy":
+            lines.append(f"  {r.get('detail', '')}")
+        else:
+            lines.append(_schema_line(r))
+        v = by_path[r["path"]]
+        lines.append(f"  elastic: {v['verdict']}")
+        lines.extend(f"  {ln}" for ln in v["lines"])
+    lines.append(f"latest valid: {latest_valid or 'NONE'}")
+    lines.append("elastic verdict: "
+                 + ("RESUMABLE" if audit["compatible"] else "INCOMPATIBLE"))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tools.ckpt_inspect",
@@ -133,6 +230,10 @@ def main(argv=None) -> int:
                    help="emit the report as JSON")
     p.add_argument("--no-verify", action="store_false", dest="verify",
                    help="manifest only — skip the streamed CRC check")
+    p.add_argument("--schema", action="store_true", dest="schema",
+                   help="elastic audit: world size, ZeRO bucket layout, "
+                        "and per-snapshot elastic-resume verdicts "
+                        "(exit 1 on any incompatibility)")
     args = p.parse_args(argv)
 
     paths = _candidate_paths(args.target)
@@ -141,11 +242,17 @@ def main(argv=None) -> int:
               "(expected a model.<N> file or a directory of them)",
               file=sys.stderr)
         return 2
-    rows = [inspect_snapshot(path, verify=args.verify) for path in paths]
+    rows = [inspect_snapshot(path, verify=args.verify,
+                             with_schema=args.schema) for path in paths]
     latest_valid = _resume_pick(args.target)
     report = {"snapshots": rows, "latest_valid": latest_valid,
               "corrupt": sum(r["status"] in ("corrupt", "unreadable")
                              for r in rows)}
+    if args.schema:
+        audit = report["elastic"] = schema_audit(rows)
+        print(json.dumps(report) if args.as_json
+              else _render_schema(rows, audit, latest_valid))
+        return 0 if audit["compatible"] and not report["corrupt"] else 1
     print(json.dumps(report) if args.as_json
           else _render(rows, latest_valid))
     return 1 if report["corrupt"] else 0
