@@ -136,6 +136,8 @@ class CheckpointManager:
         self._t_run_start = time.perf_counter()
         self._driver_stall_s = 0.0
 
+    # replay-boundary: callers reach save() only at block edges (the
+    # producing block is synced — see snapshot.capture_to_host)
     def save(self, step: int, params, model_state=None, opt_state=None,
              driver_state: Optional[dict] = None,
              run_state: Optional[dict] = None,
@@ -179,6 +181,8 @@ class CheckpointManager:
                                    path=path)
             logger.info("checkpoint saved to %s", path)
 
+        # async_save is construction-time config — identical on every
+        # process  # replicated-by: config-derived
         if sync or self._writer is None:
             job()
         else:
@@ -210,7 +214,7 @@ class CheckpointManager:
         is pinned too.  Runs on the writer thread after each commit."""
         steps = self.steps()
         keep = set(steps[-self.keep_last:])
-        if self.keep_every:
+        if self.keep_every:  # replicated-by: config-derived
             keep.update(s for s in steps
                         if s and s % self.keep_every == 0)
         with self._pin_lock:

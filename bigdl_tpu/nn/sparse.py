@@ -79,7 +79,6 @@ def coo_spmm(coo: COOBatch, dense, impl: Optional[str] = None):
     from bigdl_tpu.ops import pallas_embed, resolve_kernel_impl
     # static gate: impl resolution is host config, n_rows/dense_shape
     # are pytree metadata and shapes/dtypes are trace-time constants
-    # graftlint: disable=GL102
     if resolve_kernel_impl(impl) == "pallas" and pallas_embed.supported(
             coo.row.shape[0], coo.n_rows, dense.shape, dense.dtype):
         return pallas_embed.embedding_bag_coo(
@@ -256,7 +255,6 @@ class SparseJoinTable(Module):
             offset = 0
             n = input[0].n_rows
             # n_rows is static pytree metadata (dense_shape), not a tracer
-            # graftlint: disable=GL102
             if any(coo.n_rows != n for coo in input):
                 raise ValueError(
                     "SparseJoinTable inputs disagree on batch size: "

@@ -56,7 +56,7 @@ def _identity(attrs, x):
 def _cast(attrs, x):
     dt = attrs.get("DstT", attrs.get("dstT", 1))
     # TF DT_DOUBLE Cast target: best-available float is intended (f32
-    # when x64 is off)                       graftlint: disable=GL104
+    # when x64 is off)
     mapping = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 9: jnp.int64,
                10: jnp.bool_, 14: jnp.bfloat16}
     return jnp.asarray(x).astype(mapping.get(int(dt), jnp.float32))
@@ -652,7 +652,6 @@ def _to_bytes_list(x):
 # TF DataType enum → numpy dtype (one map for every op that reads a
 # dtype/out_type attr)
 # wire-format enum: DT_DOUBLE must map to f64 here, consumers downcast
-# graftlint: disable=GL104
 _TF_DT_NP = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
              5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_,
              14: jnp.bfloat16, 17: np.uint16, 19: np.float16,
@@ -719,7 +718,7 @@ def _decode_any_image(attrs, contents):
     dt = int(attrs.get("dtype", 4))  # DT_UINT8=4
     if dt in (1, 2, 19):             # float32/float64/half → [0, 1]
         # DecodeImage honors the graph's requested wire dtype (host-side
-        # image decode, converted on feed)  graftlint: disable=GL104
+        # image decode, converted on feed)
         out = (out.astype({1: np.float32, 2: np.float64,
                            19: np.float16}[dt]) / 255.0)
     elif dt != 4:

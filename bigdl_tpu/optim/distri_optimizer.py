@@ -62,6 +62,7 @@ from bigdl_tpu.parallel import grad_sync
 from bigdl_tpu.resilience.membership import (ClusterMembership,
                                              MembershipChanged)
 from bigdl_tpu.resilience.numeric import NonFiniteStepError
+from bigdl_tpu.utils import spmdcheck
 
 logger = logging.getLogger("bigdl_tpu.optim")
 
@@ -243,6 +244,7 @@ class DistriOptimizer(Optimizer):
             return
         self.set_elastic()
 
+    # replay-boundary: runs before any block is staged on this epoch
     def _adopt_membership_roster(self) -> None:
         """An epoch opened BETWEEN runs (operator ``request_resize``
         before ``optimize()``): nothing is in flight, so adopt the
@@ -254,8 +256,12 @@ class DistriOptimizer(Optimizer):
         if m is None:
             return
         cur = m.current()
+        # replicated-by: membership-epoch-ledger
         if tuple(cur.devices) == tuple(self.mesh.devices.flat):
             return
+        # spmdcheck: roster adoption re-keys every later collective (new
+        # mesh) — all processes must adopt the same epoch here
+        spmdcheck.note("membership_adopt", axis=f"epoch{cur.epoch}")
         self.mesh = Mesh(np.asarray(cur.devices), ("data",))
         if self.model._params is not None:
             # params may still be committed to the old roster's devices
@@ -270,6 +276,8 @@ class DistriOptimizer(Optimizer):
         self._flight_event("resize_adopt", epoch=cur.epoch,
                            world=cur.world, reason=cur.reason)
 
+    # replay-boundary: the driver replayed/abandoned the in-flight block
+    # before raising MembershipChanged — restore lands on a block edge
     def _resume_after_resize(self, e: MembershipChanged) -> None:
         """Rebuild the mesh on the new epoch's roster and restore the
         latest valid snapshot so the next ``_optimize_impl`` resumes on
@@ -320,6 +328,9 @@ class DistriOptimizer(Optimizer):
             return ostate
         want = [(s,) for s in self._gs_plan.bucket_sizes]
         got = [tuple(np.shape(m)) for m in ostate["master"]]
+        # plan shapes derive from config + model; the restored state is
+        # the same snapshot on every host
+        # replicated-by: snapshot-schema
         if want == got:
             return ostate
         logger.info(
@@ -410,6 +421,10 @@ class DistriOptimizer(Optimizer):
 
     def _make_global(self, arr: np.ndarray, sharding: NamedSharding):
         """Per-host local shard → global device array (multi-host safe)."""
+        # spmdcheck: assembling a global array is a rendezvous — noted
+        # even on the single-process path so emulated schedules match
+        # what a real pod would run
+        spmdcheck.note("make_global", payload=arr)
         if jax.process_count() == 1:
             return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(sharding, arr)
@@ -478,6 +493,11 @@ class DistriOptimizer(Optimizer):
 
         def place(a):
             a = np.asarray(a)
+            # the dataset layer shards per host from the same global
+            # source: batch shapes (and the ragged tail, if any) are
+            # identical on every process, so the fallback choice —
+            # and the collective in _make_global — stays uniform
+            # replicated-by: global-batch-layout
             if a.shape[0] % n_data == 0:
                 return self._make_global(a, data_sh)
             # ragged last eval batch: single-process can fall back to a
@@ -503,6 +523,9 @@ class DistriOptimizer(Optimizer):
     def _host_global(self, arr):
         """Globally-sharded device array → host array every process sees
         fully (process_allgather under multi-host)."""
+        # spmdcheck: noted before the single-process early return so the
+        # emulated schedule records the allgather a real pod would issue
+        spmdcheck.note("allgather", payload=arr)
         if jax.process_count() == 1:
             return arr
         from jax.experimental import multihost_utils
@@ -522,6 +545,7 @@ class DistriOptimizer(Optimizer):
                 # and a process-0-only update would make that predicate
                 # diverge — non-zero hosts would enter the allgather
                 # above while process 0 skips it (collective deadlock)
+                # replicates: checkpoint-step-mirror
                 self._checkpoint_manager().last_saved_step = \
                     int(self.state["neval"])
                 return
@@ -539,6 +563,8 @@ class DistriOptimizer(Optimizer):
             bucket_content=grad_sync.bucket_content_sizes(self._gs_plan))
 
     # ------------------------------------------------------------- train
+    # replay-boundary: restores happen only between _optimize_impl runs,
+    # after the failed run's blocks are torn down
     def optimize(self):
         attempts = 0
         while True:

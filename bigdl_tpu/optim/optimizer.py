@@ -73,6 +73,7 @@ from bigdl_tpu.resilience.membership import (ClusterMembership,
 from bigdl_tpu.resilience.numeric import (NonFiniteStepError,
                                           validate_policy)
 from bigdl_tpu.telemetry import DriverTelemetry, NULL_SPAN, jit_cache_size
+from bigdl_tpu.utils import spmdcheck
 from bigdl_tpu.utils.metrics import Metrics
 
 logger = logging.getLogger("bigdl_tpu.optim")
@@ -314,6 +315,7 @@ class Optimizer:
         self.preemption_handling = bool(enabled)
         return self
 
+    # replay-boundary: run start — nothing is in flight before optimize()
     def resume(self, path: Optional[str] = None) -> bool:
         """Restore the latest VALID snapshot (corrupt/torn ones are
         skipped, never loaded) from the configured checkpoint directory
@@ -720,6 +722,9 @@ class Optimizer:
             m.signal_device_loss(to=clause.to)
 
     def _maybe_checkpoint(self, params, mstate, ostate):
+        # the trigger reads only driver counters, which advance in
+        # lockstep on every process (the replay adds the same global
+        # increments)  # replicated-by: lockstep-driver-counters
         if self.checkpoint_trigger and self.checkpoint_path \
                 and self.checkpoint_trigger(self.state):
             with self._tel_span("checkpoint", "trigger",
@@ -733,6 +738,9 @@ class Optimizer:
         one-block-behind loss fetch has already synced the producing
         block — the capture inside ``CheckpointManager.save`` is a
         D2H copy, never a pipeline drain (GL107 discipline)."""
+        # spmdcheck: checkpoint capture gathers sharded state — every
+        # process must reach it at the same replayed iteration
+        spmdcheck.note("checkpoint", payload=params)
         mgr = self._checkpoint_manager()
         pos = getattr(self.dataset, "position_state", None)
         run_state = {"seed": self.seed,
@@ -742,6 +750,9 @@ class Optimizer:
                  schema=self._checkpoint_schema(params), sync=sync)
 
     def _run_validation(self, params, mstate) -> Optional[dict]:
+        # same lockstep counters as the checkpoint trigger: validation
+        # (a collective under multi-host eval) fires on every process
+        # or none  # replicated-by: lockstep-driver-counters
         if not (self.validation_trigger and self.validation_methods
                 and self.validation_dataset is not None
                 and self.validation_trigger(self.state)):
@@ -1084,6 +1095,10 @@ class Optimizer:
             preempt.install()
         try:
             while True:
+                # the scheduler evicts the whole slice at once — every
+                # host's grace window opens together, so polling the
+                # flag at block granularity stays uniform
+                # replicated-by: pod-eviction-broadcast
                 if preempt is not None and preempt.triggered:
                     # preemption: finish the in-flight block (replay
                     # syncs it — params/state land on an exact block
@@ -1105,6 +1120,11 @@ class Optimizer:
                     self._flight_event("preemption",
                                        iteration=state["neval"])
                     mgr.wait()  # writer idle → no concurrent GC below
+                    # every process records the step when a multi-host
+                    # checkpoint commits (the PR-7 mirror write in
+                    # DistriOptimizer._do_checkpoint), so this dedup
+                    # cannot send hosts down different sides of the
+                    # allgather  # replicated-by: checkpoint-step-mirror
                     if mgr.last_saved_step != state["neval"]:
                         # a trigger checkpoint that fired on this very
                         # iteration already covers it — don't burn the
@@ -1135,6 +1155,10 @@ class Optimizer:
                                                    mstate, ostate)
                                 pending = None
                             mgr.wait()  # writer idle → no racing GC
+                            # same mirror contract as the preemption
+                            # dedup above (see DistriOptimizer.
+                            # _do_checkpoint's non-zero-process write)
+                            # replicated-by: checkpoint-step-mirror
                             if mgr.last_saved_step != state["neval"]:
                                 self._do_checkpoint(params, mstate,
                                                     ostate, sync=True)
@@ -1162,6 +1186,10 @@ class Optimizer:
                 new_fn = fn is None
                 if new_fn:
                     fn = block_fns[k] = self._build_block_fn(grad_fn, k)
+                # spmdcheck: the fused block is one SPMD program — every
+                # process must dispatch the same block shape in the same
+                # order or the in-step collectives go one-sided
+                spmdcheck.note("dispatch", axis=f"k{k}", payload=staged.xs)
                 t0 = time.perf_counter()
                 with self._tel_span("dispatch", "dispatch", k=k,
                                     compile=new_fn):
@@ -1282,6 +1310,7 @@ class Optimizer:
                            loss=float(losses[j]))
         raise NonFiniteStepError(step, float(losses[j]), policy)
 
+    # replay-boundary: the failed block is torn down before the restore
     def _rollback_nonfinite(self, e: NonFiniteStepError,
                             attempts: int, retry_budget: int) -> None:
         """``numeric_guard="rollback"`` recovery shared by both
@@ -1349,6 +1378,9 @@ class Optimizer:
         end_when check.  Returns True when training should stop."""
         tel = self._telemetry
         t_wait0 = time.perf_counter()
+        # spmdcheck: the fetch syncs the producing block on every
+        # process — a one-sided fetch deadlocks the block's collectives
+        spmdcheck.note("block_fetch", payload=block.losses)
         with self.metrics.time("computing"), \
                 self._tel_span("device_wait", "device_wait",
                                steps=len(block.sizes)):
@@ -1383,6 +1415,9 @@ class Optimizer:
                 state["records_processed_this_epoch"] += n
                 state["loss"] = float(losses[j])
                 state["throughput"] = n / per_step
+                # finite flags ride the psum'd global loss — every
+                # process fetches the same reduced values
+                # replicated-by: global-loss-reduction
                 if finite is not None and not finite[j]:
                     self._on_nonfinite_step(j, losses)
                 lr = block.lrs[j]
@@ -1394,6 +1429,9 @@ class Optimizer:
                     self._log_parameter_histograms(params)
                 state["epoch_finished"] = \
                     state["records_processed_this_epoch"] >= self._epoch_size
+                # the records counter advances by GLOBAL records, so
+                # epoch rollover (shuffle + iterator reset) is uniform
+                # replicated-by: lockstep-driver-counters
                 if state["epoch_finished"]:
                     state["epoch"] += 1
                     state["records_processed_this_epoch"] = 0
@@ -1412,6 +1450,9 @@ class Optimizer:
                     for clause in self._fault_injector \
                             .membership_events(state["neval"] - 1):
                         self._apply_membership_clause(clause)
+                # end_when reads the same lockstep counters — training
+                # stops on every process at the same iteration
+                # replicated-by: lockstep-driver-counters
                 if self.end_when(state):
                     ended = True
                     break

@@ -62,6 +62,8 @@ class _SeveralIteration(Trigger):
 
     def __call__(self, state):
         n = state.get("neval", 0)
+        # neval advances identically on every process (lockstep driver)
+        # replicated-by: lockstep-driver-counters
         return n > 0 and n % self.interval == 0
 
 
@@ -87,6 +89,9 @@ class _MaxScore(Trigger):
 
     def __call__(self, state):
         s = state.get("score")
+        # score is set from the gathered (multi-host: allgathered)
+        # validation result — the same value lands on every process
+        # replicated-by: global-loss-reduction
         return s is not None and s >= self.max_score
 
 
@@ -96,6 +101,8 @@ class _MinLoss(Trigger):
 
     def __call__(self, state):
         l = state.get("loss")
+        # loss is the psum'd global mean — uniform by reduction
+        # replicated-by: global-loss-reduction
         return l is not None and l <= self.min_loss
 
 

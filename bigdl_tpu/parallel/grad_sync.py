@@ -132,6 +132,10 @@ def build_plan(params, n_shard: int, bucket_bytes: int) -> BucketPlan:
     cur: List[int] = []
     cur_n = 0
     for i, (_, size, _) in enumerate(leaf_meta):
+        # bucketing is a pure function of the param tree and
+        # grad_bucket_bytes — every host derives the identical plan
+        # (and therefore the identical collective schedule)
+        # replicated-by: plan-from-config
         if cur and cur_n + size > cap:
             buckets.append(cur)
             sizes.append(cur_n)
@@ -153,7 +157,7 @@ def flatten_to_buckets(plan: BucketPlan, tree) -> List[jnp.ndarray]:
         flat = jnp.concatenate(
             [leaves[i].reshape(-1).astype(jnp.float32) for i in idxs])
         pad = plan.bucket_sizes[b] - flat.shape[0]
-        if pad:
+        if pad:  # replicated-by: plan-from-config
             flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
         out.append(flat)
     return out
@@ -188,6 +192,8 @@ def init_state(plan: BucketPlan, params, optim_method) -> dict:
     inner = optim_method.init_state(masters)
     master_shapes = {m.shape for m in masters}
     for leaf in jax.tree_util.tree_leaves(inner):
+        # model structure is identical on every host — the refusal (or
+        # not) is uniform  # replicated-by: model-structure
         if leaf.shape not in master_shapes:
             raise ValueError(
                 f"grad_sync requires an elementwise optimizer whose "
@@ -234,12 +240,14 @@ def reshard_state(plan: BucketPlan, gs_state: dict) -> dict:
 
     def _repad(path, leaf):
         b = _bucket_ix(path)
-        if b >= len(content):
+        if b >= len(content):  # replicated-by: plan-from-config
             raise ValueError(
                 f"grad_sync reshard: state has a bucket #{b} but the "
                 f"new plan only has {plan.num_buckets} — param tree or "
                 f"grad_bucket_bytes changed, not just the world size")
         arr = np.asarray(leaf)
+        # every host restored the same snapshot — its bucket layout is
+        # uniform  # replicated-by: snapshot-schema
         if arr.ndim != 1 or arr.shape[0] < content[b]:
             raise ValueError(
                 f"grad_sync reshard: bucket #{b} holds "
@@ -266,7 +274,7 @@ def wire_cast(x, wire_dtype, key, n_sum: int = 1):
     for a bounded, clipping-like bias on the rare overflowing element,
     the same behavior as NCCL-style fp16 rings."""
     wd = jnp.dtype(wire_dtype)
-    if wd == jnp.float32:
+    if wd == jnp.float32:  # replicated-by: config-derived
         return x
     if wd == jnp.float16:
         lim = float(jnp.finfo(jnp.float16).max) / max(1, int(n_sum))

@@ -81,6 +81,11 @@ class GPipe(Module):
         self.num_stages = num_stages
         self.mesh = mesh
         self.axis = axis
+        # eager state-template capture: the pipelined schedule needs the
+        # stage's static state STRUCTURE even when the caller threads no
+        # state; computing it at construction keeps apply() free of
+        # host-side memo writes inside a traced scope
+        _, self._state_template = stage.init(jax.random.PRNGKey(0))
 
     def init(self, rng):
         ks = jax.random.split(rng, self.num_stages)
@@ -102,12 +107,6 @@ class GPipe(Module):
         return NamedSharding(self.mesh, P(self.axis))
 
     def _template(self):
-        if not hasattr(self, "_state_template"):
-            _, st = self.stage.init(jax.random.PRNGKey(0))
-            # host-side lazy memo of the STATIC state-template structure
-            # (independent of traced inputs; same value on every trace)
-            # graftlint: disable=GL103
-            self._state_template = st
         return self._state_template
 
     # pure single-device reference (for parity tests): sequential stages

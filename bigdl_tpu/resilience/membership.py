@@ -127,6 +127,10 @@ class ClusterMembership:
         the driver's replay-boundary check, one lock round-trip."""
         with self._lock:
             cur = self._epochs[-1]
+        # the epoch ledger is the control plane's broadcast: every host
+        # observes the same ledger, so the driver's resize branch is
+        # uniform at its replay boundary
+        # replicated-by: membership-epoch-ledger
         return cur if cur.epoch > epoch else None
 
     # ----------------------------------------------------------- signals
@@ -160,12 +164,14 @@ class ClusterMembership:
     def _open(self, world: int, reason: str,
               graceful: bool) -> MembershipEpoch:
         world = int(world)
+        # replicated-by: membership-epoch-ledger
         if not 1 <= world <= len(self._pool):
             raise ValueError(
                 f"resize target {world} outside [1, {len(self._pool)}] "
                 f"(the armed device pool bounds every roster)")
         with self._lock:
             cur = self._epochs[-1]
+            # replicated-by: membership-epoch-ledger
             if cur.world == world:
                 return cur  # roster unchanged — no epoch churn
             nxt = MembershipEpoch(cur.epoch + 1, self._pool[:world],

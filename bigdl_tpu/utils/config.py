@@ -192,6 +192,15 @@ class Config:
     # disables the wall-clock check.
     lockdep: bool = False
     lockdep_hold_ms: float = 200.0
+    # spmdcheck (utils/spmdcheck.py): collective-schedule sanitizer for
+    # multi-host SPMD divergence — the runtime twin of graftlint
+    # GL401-GL404.  False (default) = provably inert: the driver's
+    # note sites read one module global and return; nothing is
+    # allocated.  True (or BIGDL_TPU_SPMDCHECK=1) records the sequence
+    # of (op kind, axis, payload treedef/dtype) each emulated process
+    # issues and the first cross-process mismatch is reported with
+    # both schedules + both stacks.
+    spmdcheck: bool = False
     # mesh defaults (dryrun/tests override explicitly)
     mesh_data: int = -1
     mesh_model: int = 1

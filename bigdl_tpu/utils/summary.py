@@ -90,7 +90,7 @@ def _histogram_proto(values: np.ndarray) -> bytes:
     """HistogramProto: min=1,max=2,num=3,sum=4,sum_squares=5,
     bucket_limit=6 (repeated double), bucket=7 (repeated double)."""
     # tensorboard HistogramProto fields are doubles on the wire
-    v = np.asarray(values, np.float64).ravel()  # graftlint: disable=GL104
+    v = np.asarray(values, np.float64).ravel()
     if v.size == 0:
         v = np.zeros(1)
     # tensorboard-style exponential buckets

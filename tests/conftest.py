@@ -35,6 +35,29 @@ if os.environ.get("BIGDL_TPU_LOCKDEP", "").lower() in (
     _LOCKDEP_MOD.install(hold_ms=float(
         os.environ.get("BIGDL_TPU_LOCKDEP_HOLD_MS", "200")))
 
+# Spmdcheck opt-in (BIGDL_TPU_SPMDCHECK=1): the collective-schedule
+# sanitizer (runtime twin of graftlint GL4xx).  Unlike lockdep it
+# patches nothing — the driver's note sites gate on the recorder — so
+# a plain import before jax is enough.  Loaded standalone by file path
+# for the same reason as lockdep: importing through the bigdl_tpu
+# package would drag in the whole tree here.
+_SPMDCHECK_MOD = None
+if os.environ.get("BIGDL_TPU_SPMDCHECK", "").lower() in (
+        "1", "true", "yes", "on"):
+    import importlib.util
+    import sys as _sys2
+    _sc_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "bigdl_tpu", "utils", "spmdcheck.py")
+    if "bigdl_tpu.utils.spmdcheck" in _sys2.modules:
+        _SPMDCHECK_MOD = _sys2.modules["bigdl_tpu.utils.spmdcheck"]
+    else:
+        _sc_spec = importlib.util.spec_from_file_location(
+            "bigdl_tpu.utils.spmdcheck", _sc_path)
+        _SPMDCHECK_MOD = importlib.util.module_from_spec(_sc_spec)
+        _sys2.modules["bigdl_tpu.utils.spmdcheck"] = _SPMDCHECK_MOD
+        _sc_spec.loader.exec_module(_SPMDCHECK_MOD)
+    _SPMDCHECK_MOD.install()
+
 import jax  # noqa: E402
 
 # NOTE: the env var JAX_PLATFORMS is stomped by the axon TPU plugin in this
@@ -61,24 +84,46 @@ def _reset_engine_mesh():
 
 
 def pytest_report_header(config):
+    # additive: each sanitizer contributes its own line, so running
+    # both (the composition smoke test) reports both
+    lines = []
     if _LOCKDEP_MOD is not None:
-        return ["lockdep: lock-order sanitizer INSTALLED "
-                "(BIGDL_TPU_LOCKDEP) — cycles fail the session"]
-    return []
+        lines.append("lockdep: lock-order sanitizer INSTALLED "
+                     "(BIGDL_TPU_LOCKDEP) — cycles fail the session")
+    if _SPMDCHECK_MOD is not None:
+        lines.append("spmdcheck: collective-schedule sanitizer "
+                     "INSTALLED (BIGDL_TPU_SPMDCHECK) — divergences "
+                     "fail the session")
+    return lines
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """The lockdep gate: a run under BIGDL_TPU_LOCKDEP=1 fails when
-    any lock-order cycle was recorded, with both stacks printed."""
-    if _LOCKDEP_MOD is None:
-        return
-    cycles = _LOCKDEP_MOD.cycles()
-    edges = len(_LOCKDEP_MOD.graph_edges())
-    slow = len(_LOCKDEP_MOD.slow_holds())
-    print(f"\nlockdep: {_LOCKDEP_MOD.proxies_allocated()} locks "
-          f"instrumented, {edges} order edges, {len(cycles)} cycles, "
-          f"{slow} slow holds")
-    if cycles:
-        for c in cycles:
-            print(c.render())
-        session.exitstatus = 1
+    """The sanitizer gates: a run under BIGDL_TPU_LOCKDEP=1 fails when
+    any lock-order cycle was recorded; a run under
+    BIGDL_TPU_SPMDCHECK=1 fails when any collective-schedule
+    divergence was recorded.  Each gate reports independently — they
+    must not clobber one another when both are live."""
+    if _LOCKDEP_MOD is not None:
+        cycles = _LOCKDEP_MOD.cycles()
+        edges = len(_LOCKDEP_MOD.graph_edges())
+        slow = len(_LOCKDEP_MOD.slow_holds())
+        print(f"\nlockdep: {_LOCKDEP_MOD.proxies_allocated()} locks "
+              f"instrumented, {edges} order edges, {len(cycles)} cycles, "
+              f"{slow} slow holds")
+        if cycles:
+            for c in cycles:
+                print(c.render())
+            session.exitstatus = 1
+    if _SPMDCHECK_MOD is not None:
+        # intra-run index mismatches only: emulated participants from
+        # different tests legitimately record different-LENGTH
+        # schedules, so the length finalizer stays off at session scope
+        divs = _SPMDCHECK_MOD.divergences()
+        print(f"\nspmdcheck: {_SPMDCHECK_MOD.notes_recorded()} "
+              f"collective notes, "
+              f"{len(_SPMDCHECK_MOD.schedules())} participants, "
+              f"{len(divs)} divergences")
+        if divs:
+            for d in divs:
+                print(d.render())
+            session.exitstatus = 1

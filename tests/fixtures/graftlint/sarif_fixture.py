@@ -1,0 +1,22 @@
+"""Checked-in SARIF fixture: two violations at FIXED lines.
+
+The test copies this file under a synthetic ``bigdl_tpu/parallel/``
+path (library scope, so traced rules are live) and compares the CLI's
+``--format sarif`` output against ``sarif_fixture.expected.json``.
+Editing this file means regenerating the expected results.
+"""
+
+import os
+
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def maybe_sync(arr, flag_path):
+    if os.path.exists(flag_path):                # per-host predicate
+        return multihost_utils.process_allgather(arr)  # GL401 (line 17)
+    return arr
+
+
+def noisy_init(shape):
+    return np.random.normal(0, 1, shape)         # GL105 (line 22)

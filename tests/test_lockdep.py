@@ -173,6 +173,37 @@ class TestNoFalsePositives:
         assert lockdep.cycles() == []
         assert (next(iter(lockdep.graph_edges().values()))) >= 4
 
+    def test_acyclic_chain_plus_independent_lock_is_clean(self, sandbox):
+        """ISSUE-17 regression guard: a strict A -> B -> C hierarchy
+        exercised from several threads, plus an independent lock D
+        taken under all three, builds a 3+ edge DAG and must stay
+        cycle-free — ``check_clean`` passes.  (The positive twin is
+        TestCycleDetection; this pins the no-false-positive side so a
+        graph-search change cannot start reporting hierarchies.)"""
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.Lock()
+        d = threading.Lock()
+
+        def chain_worker():
+            for _ in range(10):
+                with a:
+                    with b:
+                        with c:
+                            with d:
+                                pass
+
+        ts = [threading.Thread(target=chain_worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10.0)
+        for t in ts:
+            assert not t.is_alive()
+        assert lockdep.cycles() == []
+        assert len(lockdep.graph_edges()) >= 3   # a->b, b->c, c->d at least
+        lockdep.check_clean()                    # no raise
+
     def test_rlock_reentrancy_records_no_self_edge(self, sandbox):
         r = threading.RLock()
         with r:

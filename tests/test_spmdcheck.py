@@ -1,0 +1,335 @@
+"""spmdcheck — the runtime half of the SPMD divergence story (ISSUE 17).
+
+Layout:
+- THE POSITIVE GATE: two emulated processes whose collective schedules
+  diverge produce one report naming both entries, both stacks and both
+  full schedules;
+- negatives: identical schedules across K participants record nothing;
+- THE INERTNESS GATE: with the sanitizer off, ``note()`` is a single
+  global read (zero notes, zero allocations visible) and the driver
+  loop is bitwise identical (loss sequence + dispatch count) for
+  K ∈ {1, 4} — the lockdep/FaultInjector empty-plan discipline;
+- the real-driver emulation: the SAME ``tiny_run`` under
+  ``participant(pid)`` per pid records identical schedules; an
+  injected one-sided clause (the PR-7 ``last_saved_step`` class) fails
+  with both schedules rendered;
+- composition: lockdep + spmdcheck installed in ONE subprocess session
+  — both report headers, both summary lines, neither clobbers the
+  other's gate.
+
+Unlike lockdep, spmdcheck patches nothing: the off state is one module
+global being None.  Tests therefore isolate by SWAPPING the recorder
+(save/restore ``_RECORDER``) instead of skipping under the session
+opt-in — every test here runs under ``BIGDL_TPU_SPMDCHECK=1`` too.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.transformer import Sample, SampleToMiniBatch
+from bigdl_tpu.utils import spmdcheck
+from bigdl_tpu.utils.config import configure, reset_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def sandbox():
+    """Fresh recorder for one test; the previous recorder (the session
+    one, under BIGDL_TPU_SPMDCHECK=1) is restored untouched after."""
+    prev = spmdcheck._RECORDER
+    spmdcheck._RECORDER = None
+    spmdcheck.install()
+    try:
+        yield spmdcheck
+    finally:
+        spmdcheck._RECORDER = prev
+
+
+@pytest.fixture
+def off_sandbox():
+    """The sanitizer provably OFF for one test, session state restored
+    after — no skip needed even under the session opt-in."""
+    prev = spmdcheck._RECORDER
+    spmdcheck._RECORDER = None
+    try:
+        yield spmdcheck
+    finally:
+        spmdcheck._RECORDER = prev
+
+
+class RecordingSummary:
+    def __init__(self):
+        self.losses = []
+
+    def add_train_step(self, step, loss, lr, throughput):
+        self.losses.append(loss)
+
+    def add_scalar(self, *a):
+        pass
+
+    def trigger_for(self, name):
+        return None
+
+
+def tiny_run(iters=6, k=1):
+    rng = np.random.default_rng(0)
+    samples = [Sample(rng.normal(0, 1, (16,)).astype(np.float32),
+                      np.int32(rng.integers(0, 4)))
+               for _ in range(64)]
+    model = nn.Sequential(nn.Linear(16, 16), nn.ReLU(),
+                          nn.Linear(16, 4), nn.LogSoftMax())
+    rec = RecordingSummary()
+    opt = (optim.LocalOptimizer(model,
+                                DataSet.array(samples)
+                                >> SampleToMiniBatch(16),
+                                nn.ClassNLLCriterion())
+           .set_optim_method(optim.SGD(learning_rate=0.1))
+           .set_seed(7)
+           .set_train_summary(rec)
+           .set_steps_per_dispatch(k)
+           .set_end_when(optim.max_iteration(iters)))
+    opt.optimize()
+    return np.asarray(rec.losses), opt
+
+
+# ===========================================================================
+class TestDivergenceDetection:
+    def test_identical_schedules_are_clean(self, sandbox):
+        for pid in (0, 1, 2):
+            with spmdcheck.participant(pid):
+                spmdcheck.note("dispatch", axis="k4",
+                               payload=np.zeros((4, 8), np.float32))
+                spmdcheck.note("block_fetch",
+                               payload=np.zeros((4,), np.float32))
+        assert spmdcheck.divergences(final=True) == []
+        assert spmdcheck.notes_recorded() == 6
+        spmdcheck.check_clean()  # no raise
+
+    def test_one_sided_clause_names_both_schedules_and_stacks(
+            self, sandbox):
+        """THE ISSUE-17 acceptance gate: one process takes a branch the
+        other never does (the PR-7 ``last_saved_step`` class) — the
+        report carries both entries, both stacks, both schedules."""
+        loss = np.zeros((3,), np.float32)
+        for pid in (0, 1):
+            with spmdcheck.participant(pid):
+                spmdcheck.note("dispatch", axis="k1", payload=loss)
+                if pid == 0:      # the one-sided clause
+                    spmdcheck.note("checkpoint", payload=loss)
+                spmdcheck.note("allgather", payload=loss)
+        divs = spmdcheck.divergences(final=True)
+        assert len(divs) == 1
+        rep = divs[0].render()
+        assert "checkpoint" in rep and "allgather" in rep
+        assert "schedule of process 0" in rep
+        assert "schedule of process 1" in rep
+        assert rep.count("test_spmdcheck.py") >= 2  # both stacks
+        with pytest.raises(spmdcheck.SpmdDivergenceError):
+            spmdcheck.check_clean()
+
+    def test_payload_fingerprint_mismatch_is_a_divergence(self, sandbox):
+        with spmdcheck.participant(0):
+            spmdcheck.note("dispatch",
+                           payload=np.zeros((4,), np.float32))
+        with spmdcheck.participant(1):
+            spmdcheck.note("dispatch", payload=np.zeros((4,), np.int32))
+        (d,) = spmdcheck.divergences()
+        rep = d.render()
+        assert "float32" in rep and "int32" in rep
+
+    def test_axis_mismatch_is_a_divergence(self, sandbox):
+        with spmdcheck.participant(0):
+            spmdcheck.note("allgather", axis="data")
+        with spmdcheck.participant(1):
+            spmdcheck.note("allgather", axis="model")
+        assert len(spmdcheck.divergences()) == 1
+
+    def test_one_report_per_pair_not_per_entry(self, sandbox):
+        # a schedule that slid out of phase mismatches at EVERY later
+        # index; the pair reports once
+        with spmdcheck.participant(0):
+            for kind in ("a", "b", "c", "d"):
+                spmdcheck.note(kind)
+        with spmdcheck.participant(1):
+            for kind in ("b", "c", "d", "a"):
+                spmdcheck.note(kind)
+        assert len(spmdcheck.divergences(final=True)) == 1
+
+    def test_length_mismatch_only_reported_at_finalize(self, sandbox):
+        with spmdcheck.participant(0):
+            spmdcheck.note("dispatch")
+            spmdcheck.note("allgather")
+        with spmdcheck.participant(1):
+            spmdcheck.note("dispatch")   # then stops noting
+        # mid-run: schedules legitimately grow at different rates
+        assert spmdcheck.divergences() == []
+        (d,) = spmdcheck.divergences(final=True)
+        assert d.entry_b is None  # participant 1 ended early
+        assert "<schedule ended>" in d.render()
+
+    def test_participant_nesting_restores_previous_pid(self, sandbox):
+        with spmdcheck.participant(3):
+            with spmdcheck.participant(5):
+                spmdcheck.note("inner")
+            spmdcheck.note("outer")
+        scheds = spmdcheck.schedules()
+        assert [e.kind for e in scheds[5]] == ["inner"]
+        assert [e.kind for e in scheds[3]] == ["outer"]
+
+
+# ===========================================================================
+class TestInertness:
+    """The acceptance gate: spmdcheck off is ONE global read in
+    ``note()`` — nothing recorded, nothing imported, driver bitwise."""
+
+    def test_off_state_records_and_allocates_nothing(self, off_sandbox):
+        assert not spmdcheck.installed()
+        configure(spmdcheck=False)
+        try:
+            assert spmdcheck.maybe_install() is False
+        finally:
+            reset_config()
+        assert not spmdcheck.installed()
+        spmdcheck.note("dispatch", axis="k1", payload=object())
+        assert spmdcheck.notes_recorded() == 0
+        assert spmdcheck.schedules() == {}
+        assert spmdcheck.divergences(final=True) == []
+        spmdcheck.check_clean()  # vacuously clean, no raise
+
+    @pytest.mark.parametrize("k", [1, 4])
+    def test_driver_bitwise_identical_off_vs_on(self, k):
+        prev = spmdcheck._RECORDER
+        spmdcheck._RECORDER = None
+        try:
+            configure(spmdcheck=False)
+            try:
+                assert spmdcheck.maybe_install() is False
+            finally:
+                reset_config()
+            off_l, off_o = tiny_run(iters=6, k=k)
+            assert spmdcheck.notes_recorded() == 0
+            spmdcheck.install()
+            on_l, on_o = tiny_run(iters=6, k=k)
+            assert spmdcheck.notes_recorded() > 0
+            assert spmdcheck.divergences(final=True) == []
+        finally:
+            spmdcheck._RECORDER = prev
+        np.testing.assert_array_equal(off_l, on_l)
+        assert off_o._dispatch_count == on_o._dispatch_count
+
+    def test_config_gate_installs_when_on(self, off_sandbox):
+        configure(spmdcheck=True)
+        try:
+            assert spmdcheck.maybe_install() is True
+            assert spmdcheck.installed()
+        finally:
+            reset_config()
+
+    def test_env_gate_maps_to_config(self, monkeypatch, off_sandbox):
+        monkeypatch.setenv("BIGDL_TPU_SPMDCHECK", "1")
+        reset_config()
+        try:
+            from bigdl_tpu.utils.config import get_config
+            assert get_config().spmdcheck is True
+            assert spmdcheck.maybe_install() is True
+        finally:
+            reset_config()
+
+    def test_install_uninstall_idempotent(self, off_sandbox):
+        spmdcheck.install()
+        rec = spmdcheck._RECORDER
+        spmdcheck.install()
+        assert spmdcheck._RECORDER is rec  # second install is a no-op
+        spmdcheck.uninstall()
+        spmdcheck.uninstall()
+        assert not spmdcheck.installed()
+
+
+# ===========================================================================
+class TestDriverEmulation:
+    """The virtual-mesh trick, applied to schedules: run the REAL fused
+    driver once per emulated process over the same data and compare
+    what the note sites recorded."""
+
+    def test_emulated_processes_record_identical_schedules(self,
+                                                           sandbox):
+        for pid in (0, 1):
+            with spmdcheck.participant(pid):
+                tiny_run(iters=4, k=2)
+        scheds = spmdcheck.schedules()
+        assert set(scheds) == {0, 1}
+        assert len(scheds[0]) > 0
+        briefs = {p: [e.brief() for e in s] for p, s in scheds.items()}
+        assert briefs[0] == briefs[1]
+        assert spmdcheck.divergences(final=True) == []
+        # the driver notes both boundaries: dispatch and the replay
+        # fetch, in dispatch-then-fetch order
+        kinds = {e.kind for e in scheds[0]}
+        assert kinds == {"dispatch", "block_fetch"}
+        assert scheds[0][0].kind == "dispatch"
+
+    def test_mismatched_block_shapes_across_processes_diverge(
+            self, sandbox):
+        # one host staging K=1 blocks while the other runs K=2 is
+        # exactly the out-of-phase failure the fingerprint catches
+        with spmdcheck.participant(0):
+            tiny_run(iters=4, k=1)
+        with spmdcheck.participant(1):
+            tiny_run(iters=4, k=2)
+        divs = spmdcheck.divergences(final=True)
+        assert divs
+        rep = divs[0].render()
+        assert "k1" in rep and "k2" in rep
+
+    def test_injected_one_sided_clause_around_the_real_driver(
+            self, sandbox):
+        for pid in (0, 1):
+            with spmdcheck.participant(pid):
+                losses, _opt = tiny_run(iters=3, k=1)
+                if pid == 0:   # the injected one-sided clause
+                    spmdcheck.note("checkpoint", payload=losses)
+                spmdcheck.note("allgather", payload=losses)
+        divs = spmdcheck.divergences(final=True)
+        assert len(divs) == 1
+        rep = divs[0].render()
+        assert "checkpoint" in rep
+        assert "schedule of process 0" in rep
+        assert "schedule of process 1" in rep
+
+
+# ===========================================================================
+class TestComposition:
+    """ISSUE-17 satellite: both sanitizers live in ONE pytest session
+    (BIGDL_TPU_LOCKDEP=1 BIGDL_TPU_SPMDCHECK=1) without clobbering each
+    other — both report headers, both summary lines, exit 0 on a
+    clean threaded suite."""
+
+    def test_both_sanitizers_in_one_session(self):
+        env = dict(os.environ,
+                   BIGDL_TPU_LOCKDEP="1",
+                   BIGDL_TPU_SPMDCHECK="1",
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO)
+        # no -q: quiet mode suppresses pytest_report_header output,
+        # which is half of what this test asserts on
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             os.path.join(REPO, "tests", "test_membership.py"),
+             "-p", "no:cacheprovider", "-p", "no:randomly"],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr
+        # both header lines (pytest_report_header is additive)
+        assert "lockdep: lock-order sanitizer INSTALLED" in r.stdout
+        assert "spmdcheck: collective-schedule sanitizer INSTALLED" \
+            in r.stdout
+        # both summary lines (pytest_sessionfinish reports per gate)
+        assert "locks instrumented" in r.stdout
+        assert "divergences" in r.stdout

@@ -28,7 +28,7 @@ import re
 import subprocess
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
-from tools.graftlint import resources, threads, tracing
+from tools.graftlint import resources, spmd, threads, tracing
 
 SEVERITIES = ("error", "warning")
 
@@ -160,6 +160,7 @@ class FileContext:
         self.traced = tracing.TracedModel(self.tree, path)
         self.threads = threads.ThreadModel(self.tree, source, path)
         self.resources = resources.ResourceModel(self.tree, source, path)
+        self.spmd = spmd.SpmdModel(self.tree, source, path)
         norm = path.replace(os.sep, "/")
         base = os.path.basename(norm)
         self.is_test = ("/tests/" in norm or norm.startswith("tests/")
@@ -351,6 +352,50 @@ def expand_changed_with_importers(files: Sequence[str],
     return out
 
 
+# ------------------------------------------------- mechanism ledger (GL401)
+
+def _mechanism_ledger_full(files: Sequence[str],
+                           select: Optional[Sequence[str]] = None,
+                           ) -> Tuple[List[Violation], List[Violation]]:
+    """The repo-level half of GL401's ``*-mirror`` contract: every
+    ``# replicated-by: <x>-mirror`` use must have a ``# replicates:
+    <x>-mirror`` provider write SOMEWHERE in the scanned set.  Per-file
+    analysis cannot see this (the consumer and the mirror write live in
+    different files — optimizer.py relies on the write in
+    distri_optimizer.py), so the ledger runs once over the whole file
+    list in :func:`lint_paths`.  Deleting the mirror write (the PR-7
+    revert) fails here.  Returns (kept, suppressed)."""
+    rule = REGISTRY.get("GL401") if REGISTRY else None
+    if rule is None:
+        rule = next((r for r in all_rules() if r.id == "GL401"), None)
+    if rule is None or (select and not _selected(rule, select)):
+        return [], []
+    models: List[spmd.SpmdModel] = []
+    sups: Dict[str, Suppressions] = {}
+    for f in files:
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=f)
+        except (OSError, SyntaxError):
+            continue
+        m = spmd.SpmdModel(tree, src, f)
+        models.append(m)
+        sups[m.path] = Suppressions(src)
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
+    for path, line, mech in spmd.mechanism_ledger(models):
+        v = Violation(
+            rule.id, rule.name, rule.severity, path, line, 1,
+            f"`# replicated-by: {mech}` relies on a mirror write no "
+            f"scanned file provides (`# replicates: {mech}`): without "
+            "the mirror the predicate is per-host and the collective "
+            "below this branch goes one-sided")
+        (suppressed if path in sups and sups[path].is_suppressed(v)
+         else kept).append(v)
+    return kept, suppressed
+
+
 @dataclasses.dataclass
 class LintResult:
     violations: List[Violation]
@@ -379,6 +424,8 @@ def lint_paths(paths: Sequence[str],
     for f in files:
         with open(f, "r", encoding="utf-8") as fh:
             violations.extend(lint_source(fh.read(), path=f, select=select))
+    ledger_kept, _ = _mechanism_ledger_full(files, select)
+    violations.extend(ledger_kept)
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return LintResult(violations, len(files))
 
@@ -406,6 +453,17 @@ def lint_paths_stats(paths: Sequence[str],
             rules[v.rule]["suppressed"] += 1
             row = by_file.setdefault(_relpath(f), {})
             row[v.rule] = row.get(v.rule, 0) + 1
+    # the cross-file mirror ledger is a whole-run pass (see
+    # _mechanism_ledger_full) — its findings are GL401 debt like any
+    # other, so the dashboard and the gate must agree on them
+    ledger_kept, ledger_sup = _mechanism_ledger_full(files, select)
+    for v in ledger_kept:
+        rules.setdefault(v.rule, {"name": v.name, "findings": 0,
+                                  "suppressed": 0})["findings"] += 1
+    for v in ledger_sup:
+        rules[v.rule]["suppressed"] += 1
+        row = by_file.setdefault(_relpath(v.path), {})
+        row[v.rule] = row.get(v.rule, 0) + 1
     return {"files_scanned": len(files), "rules": rules,
             "suppressions_by_file": {p: dict(sorted(r.items()))
                                      for p, r in sorted(by_file.items())}}
@@ -484,15 +542,25 @@ def suppression_debt_delta(stats: dict, baseline: dict) -> List[str]:
 
 
 def stats_to_human(stats: dict) -> str:
-    lines = [f"{'rule':8s}{'name':26s}{'findings':>9s}{'suppressed':>11s}"]
+    lines = [f"{'rule':8s}{'name':30s}{'findings':>9s}{'suppressed':>11s}"]
     tot_f = tot_s = 0
     for rid in sorted(stats["rules"]):
         row = stats["rules"][rid]
         tot_f += row["findings"]
         tot_s += row["suppressed"]
-        lines.append(f"{rid:8s}{row['name']:26s}{row['findings']:>9d}"
+        lines.append(f"{rid:8s}{row['name']:30s}{row['findings']:>9d}"
                      f"{row['suppressed']:>11d}")
-    lines.append(f"{'total':34s}{tot_f:>9d}{tot_s:>11d}")
+    lines.append(f"{'total':38s}{tot_f:>9d}{tot_s:>11d}")
+    # the per-file debt table, ordered by (rule, path): diffable across
+    # runs, so a baseline regen shows up as clean line deltas in review
+    debt = sorted((rule, path, n)
+                  for path, row in stats.get("suppressions_by_file",
+                                             {}).items()
+                  for rule, n in row.items())
+    if debt:
+        lines.append("suppression debt by file (rule, path, count):")
+        for rule, path, n in debt:
+            lines.append(f"  {rule:8s}{path:44s}{n:>3d}")
     lines.append(f"graftlint --stats: {stats['files_scanned']} file(s)")
     return "\n".join(lines)
 

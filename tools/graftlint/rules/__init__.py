@@ -8,5 +8,6 @@ from tools.graftlint.rules import (  # noqa: F401
     purity,
     recompile,
     resource_safety,
+    spmd,
     tensor_branch,
 )

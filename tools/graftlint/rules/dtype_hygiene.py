@@ -29,6 +29,17 @@ NP_NAMES = {"np", "numpy"}
 SEEDED_CTORS = {"default_rng", "Generator", "SeedSequence", "PCG64",
                 "Philox", "MT19937", "SFC64", "BitGenerator"}
 
+# wire-format modules OUTSIDE interop/ whose f64 is mandated by an
+# external schema, exactly like interop/ itself: the tensorboard event
+# proto stores scalars as doubles (utils/summary.py) and the TF
+# DataType wire enum table needs DT_DOUBLE (ops/registry.py).  Values
+# never reach a jnp expression — they are serialized or mapped on the
+# host.
+WIRE_FORMAT_MODULES = frozenset({
+    "bigdl_tpu/utils/summary.py",
+    "bigdl_tpu/ops/registry.py",
+})
+
 
 @register
 class Float64Rule(Rule):
@@ -41,8 +52,13 @@ class Float64Rule(Rule):
     def check(self, ctx):
         # interop/ is the wire-format boundary: TF DataType enums, torch
         # t7 storage classes and protobuf schemas mandate f64 there, and
-        # everything is converted on import — exempt the whole dir
+        # everything is converted on import — exempt the whole dir, plus
+        # the named wire-format modules with the same external-schema
+        # obligation (WIRE_FORMAT_MODULES)
         if not ctx.is_library or ctx.is_interop:
+            return
+        norm = ctx.path.replace("\\", "/")
+        if any(norm.endswith(m) for m in WIRE_FORMAT_MODULES):
             return
         for n in ast.walk(ctx.tree):
             if isinstance(n, ast.Attribute) and n.attr == "float64":

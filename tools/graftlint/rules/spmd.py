@@ -1,0 +1,355 @@
+"""GL4xx SPMD/collective correctness: the multi-host divergence family.
+
+Multi-host SPMD dies differently from single-host code: not a crash but
+a **one-sided collective** — one process takes a branch the others
+don't, issues (or skips) an allgather, and the pod deadlocks with no
+stack worth reading.  Both historical bugs in this repo's lineage are
+this class:
+
+- the ``last_saved_step`` dedup (fixed by the PR-7 mirror): process 0
+  advanced a counter after saving, processes 1..N-1 kept the stale
+  value, and the next "did we already save?" branch diverged right
+  above the checkpoint allgather;
+- the ``_fast_forward`` divisibility hole (fixed by the PR-16 assert):
+  a mid-epoch resume divided a record count by a new world's records
+  scale, truncation gave hosts different skip counts, and the training
+  collectives slid out of phase.
+
+GL401-GL404 catch the class statically from the cross-process
+divergence model in tools/graftlint/spmd.py; the runtime twin is
+``BIGDL_TPU_SPMDCHECK=1`` (bigdl_tpu/utils/spmdcheck.py), which records
+per-process collective schedules and fails on the first mismatch.
+
+Escape hatch (mirrors ``# guarded-by:``): annotate the branch — or the
+assignment producing its predicate — with ``# replicated-by:
+<mechanism>`` once the value is provably uniform (mirrored on every
+process, derived from config, membership-epoch-gated).  Mechanisms
+named ``*-mirror`` are a contract, not a comment: some write site must
+carry the provider twin ``# replicates: <mechanism>`` or GL401 fails
+at the use site (the repo-level mechanism ledger) — deleting the
+mirror write fails lint even though the consumer lives in another
+file.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.graftlint import spmd
+from tools.graftlint.core import Rule, register
+from tools.graftlint.tracing import iter_scope, last_seg
+
+
+def _in_spmd_scope(ctx) -> bool:
+    """GL4xx runs on library code only: tests and dataset pipelines are
+    per-host by design (the loader is SUPPOSED to read local shards)."""
+    return ctx.is_library
+
+
+def _in_replay_scope(ctx) -> bool:
+    """GL403's blast radius: the training driver, checkpointing, and
+    resilience planes — where host fetch / capture / adoption must sit
+    at replay boundaries.  Serving and nn layers fetch freely."""
+    norm = ctx.path.replace("\\", "/")
+    return _in_spmd_scope(ctx) and any(
+        f"/{p}/" in norm or norm.startswith(f"{p}/")
+        for p in ("optim", "checkpoint", "resilience"))
+
+
+# statements that own nested statement blocks we must descend through
+# while carrying the divergence context
+_BLOCK_FIELDS = {
+    ast.If: ("body", "orelse"),
+    ast.While: ("body", "orelse"),
+    ast.For: ("body", "orelse"),
+    ast.With: ("body",),
+    ast.Try: ("body", "handlers", "orelse", "finalbody"),
+    ast.ExceptHandler: ("body",),
+}
+
+
+@register
+class DivergentCollectiveRule(Rule):
+    id = "GL401"
+    name = "divergent-collective"
+    severity = "error"
+    description = ("collective reachable under a branch whose predicate "
+                   "is process-local (process_index, clock, filesystem, "
+                   "per-host counter) — annotate a provably uniform "
+                   "predicate with `# replicated-by: <mechanism>`")
+
+    def check(self, ctx):
+        if not _in_spmd_scope(ctx):
+            return
+        model = ctx.spmd
+        for fi in model.funcs.values():
+            if id(fi.node) in ctx.traced.traced_ids:
+                continue  # traced/shard_map code is lock-step
+            taint = model.process_local_names(fi.node)
+            declared = model.declared_names(fi.node)
+
+            def divergent(test: ast.AST, stmt: ast.stmt) -> bool:
+                if model.declared_replicated(stmt):
+                    return False
+                return not model.is_uniform(test, fi.node, taint,
+                                            declared)
+
+            def flag(call: ast.Call, branch: ast.stmt):
+                kind = ("while" if isinstance(branch, ast.While)
+                        else "if")
+                return self.violation(
+                    ctx, call,
+                    f"collective `{last_seg(call.func)}` reachable "
+                    f"under process-local `{kind}` at line "
+                    f"{branch.lineno}: if any process skips it the "
+                    "rendezvous goes one-sided and the pod deadlocks; "
+                    "mirror the predicate on every process and "
+                    "annotate the branch `# replicated-by: "
+                    "<mechanism>`")
+
+            def visit(stmts, branch: Optional[ast.stmt]):
+                for s in stmts:
+                    here = branch
+                    if isinstance(s, (ast.If, ast.While)) \
+                            and here is None \
+                            and divergent(s.test, s):
+                        here = s
+                    if here is not None:
+                        for n in ast.walk(s):
+                            if isinstance(n, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.Lambda)):
+                                # nested defs are their own scope; a
+                                # def under a divergent branch only
+                                # diverges when CALLED, and the call
+                                # site is what we flag
+                                continue
+                            if isinstance(n, ast.Call) \
+                                    and model.is_collective_call(n):
+                                yield flag(n, here)
+                        continue
+                    for typ, fields in _BLOCK_FIELDS.items():
+                        if isinstance(s, typ):
+                            for f in fields:
+                                yield from visit(getattr(s, f, []), here)
+                            break
+
+            yield from visit(fi.node.body, None)
+            # expression-level branches: `x() if process_index() else y()`
+            for n in iter_scope(fi.node):
+                if isinstance(n, ast.IfExp) \
+                        and not model.is_uniform(n.test, fi.node, taint,
+                                                 declared):
+                    for arm in (n.body, n.orelse):
+                        for c in ast.walk(arm):
+                            if isinstance(c, ast.Call) \
+                                    and model.is_collective_call(c):
+                                yield self.violation(
+                                    ctx, c,
+                                    "collective in a conditional "
+                                    "expression with a process-local "
+                                    "test; both arms must issue the "
+                                    "same collectives, or the test "
+                                    "must be `# replicated-by:` "
+                                    "uniform")
+
+
+@register
+class WorldSizeDependentStateRule(Rule):
+    id = "GL402"
+    name = "world-size-dependent-state"
+    severity = "error"
+    description = ("checkpoint schema / wire-bucket state depends on "
+                   "world size without the reshard_state/elastic-schema "
+                   "path (bucket_content fingerprint) — breaks elastic "
+                   "resume at a different world size")
+
+    # build_schema kwargs that encode the CURRENT world's layout
+    WORLD_KWARGS = {"n_shard", "bucket_sizes"}
+    # world-size sources: uniform across processes, but tied to THIS
+    # world's size — poison for anything a different-sized world resumes
+    WORLD_CALLS = {"process_count", "device_count", "axis_size"}
+    # names that mark a persisted container
+    PERSISTED = ("state", "schema", "ckpt", "checkpoint")
+
+    def check(self, ctx):
+        if not _in_spmd_scope(ctx):
+            return
+        for fi in ctx.spmd.funcs.values():
+            calls_reshard = any(
+                isinstance(n, ast.Call)
+                and last_seg(n.func) == "reshard_state"
+                for n in iter_scope(fi.node))
+            for n in iter_scope(fi.node):
+                if isinstance(n, ast.Call) \
+                        and last_seg(n.func) == "build_schema":
+                    kw = {k.arg for k in n.keywords}
+                    if kw & self.WORLD_KWARGS \
+                            and "bucket_content" not in kw:
+                        yield self.violation(
+                            ctx, n,
+                            "schema carries world-size-dependent "
+                            f"layout ({', '.join(sorted(kw & self.WORLD_KWARGS))}) "
+                            "without the world-size-invariant "
+                            "bucket_content fingerprint — a resume at "
+                            "a different world size cannot validate "
+                            "or reshard this checkpoint "
+                            "(see grad_sync.reshard_state)")
+                    continue
+                if not isinstance(n, ast.Assign) or calls_reshard:
+                    continue
+                stores = any(
+                    isinstance(t, ast.Subscript)
+                    and any(p in (last_seg(t.value) or "").lower()
+                            for p in self.PERSISTED)
+                    for t in n.targets)
+                if not stores:
+                    continue
+                world = [c for c in ast.walk(n.value)
+                         if isinstance(c, ast.Call)
+                         and last_seg(c.func) in self.WORLD_CALLS]
+                for c in world:
+                    yield self.violation(
+                        ctx, n,
+                        f"`{last_seg(c.func)}()` stored into persisted "
+                        "state: the value is this world's size and a "
+                        "resume at a different size inherits it — "
+                        "recompute at restore or route through "
+                        "reshard_state")
+
+
+@register
+class ReplayBoundaryViolationRule(Rule):
+    id = "GL403"
+    name = "replay-boundary-violation"
+    severity = "error"
+    description = ("host fetch / checkpoint capture / restore outside a "
+                   "replay boundary (annotate the def `# replay-"
+                   "boundary: <why>` if it IS one) — generalizes GL107 "
+                   "to the checkpoint/membership planes")
+
+    def check(self, ctx):
+        if not _in_replay_scope(ctx):
+            return
+        model = ctx.spmd
+        for fi in model.funcs.values():
+            if id(fi.node) in ctx.traced.traced_ids:
+                continue
+            anc, bounded = fi, False
+            while anc is not None:
+                if model.is_boundary_def(anc.node):
+                    bounded = True
+                    break
+                anc = anc.parent
+            if bounded:
+                continue
+            for n in iter_scope(fi.node):
+                if isinstance(n, ast.Call) \
+                        and last_seg(n.func) in spmd.REPLAY_SINKS:
+                    yield self.violation(
+                        ctx, n,
+                        f"`{last_seg(n.func)}` in `{fi.name}`, which "
+                        "is not a replay boundary: state captured or "
+                        "adopted here is unreplayable after preemption "
+                        "— move it into a boundary def or annotate "
+                        "this def `# replay-boundary: <why>` if every "
+                        "caller reaches it only at block edges")
+
+
+@register
+class CollectiveInDivergentLoopRule(Rule):
+    id = "GL404"
+    name = "collective-in-divergent-loop"
+    severity = "error"
+    description = ("floored per-host share feeds a schedule consumer or "
+                   "collective loop without a divisibility guard — "
+                   "truncation gives hosts different trip counts (the "
+                   "_fast_forward class)")
+
+    def _floordivs(self, fi):
+        """name -> (numerator, denominator) for `x = a // b` assigns."""
+        out = {}
+        for n in iter_scope(fi.node):
+            if isinstance(n, ast.Assign) \
+                    and isinstance(n.value, ast.BinOp) \
+                    and isinstance(n.value.op, ast.FloorDiv):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = (n.value.left, n.value.right, n)
+        return out
+
+    def _guarded(self, fi, num, den) -> bool:
+        """True when `num % den` is checked for exactness: an `if` whose
+        body raises, or an assert."""
+        want = (ast.dump(num), ast.dump(den))
+
+        def mods(e):
+            for n in ast.walk(e):
+                if isinstance(n, ast.BinOp) \
+                        and isinstance(n.op, ast.Mod):
+                    yield (ast.dump(n.left), ast.dump(n.right))
+
+        for n in iter_scope(fi.node):
+            if isinstance(n, ast.Assert) and want in mods(n.test):
+                return True
+            if isinstance(n, ast.If) and want in mods(n.test) \
+                    and any(isinstance(s, ast.Raise) for s in n.body):
+                return True
+        return False
+
+    def check(self, ctx):
+        if not _in_spmd_scope(ctx):
+            return
+        model = ctx.spmd
+        for fi in model.funcs.values():
+            if id(fi.node) in ctx.traced.traced_ids:
+                continue
+            shares = self._floordivs(fi)
+            if not shares:
+                continue
+            for n in iter_scope(fi.node):
+                # floored share handed to a schedule consumer
+                if isinstance(n, ast.Call) \
+                        and last_seg(n.func) in spmd.SCHEDULE_CONSUMERS:
+                    for a in n.args:
+                        if isinstance(a, ast.Name) and a.id in shares:
+                            num, den, site = shares[a.id]
+                            if not self._guarded(fi, num, den):
+                                yield self.violation(
+                                    ctx, n,
+                                    f"`{a.id}` = floor division at "
+                                    f"line {site.lineno} feeds "
+                                    f"`{last_seg(n.func)}` without a "
+                                    "divisibility guard: when the "
+                                    "division is inexact, hosts "
+                                    "fast-forward by different "
+                                    "amounts and every later "
+                                    "collective is one-sided — guard "
+                                    "with `if a % b: raise` or "
+                                    "`assert a % b == 0`")
+                # floored share as a collective loop's trip count
+                if isinstance(n, ast.For) and isinstance(n.iter, ast.Call) \
+                        and last_seg(n.iter.func) == "range":
+                    trip = [a.id for a in n.iter.args
+                            if isinstance(a, ast.Name) and a.id in shares]
+                    if not trip:
+                        continue
+                    has_coll = any(
+                        isinstance(c, ast.Call)
+                        and model.is_collective_call(c)
+                        for c in ast.walk(n))
+                    if not has_coll:
+                        continue
+                    for name in trip:
+                        num, den, site = shares[name]
+                        if not self._guarded(fi, num, den):
+                            yield self.violation(
+                                ctx, n,
+                                f"loop trip count `{name}` is a "
+                                f"floored share (line {site.lineno}) "
+                                "and the body issues collectives: "
+                                "hosts with different remainders run "
+                                "different iteration counts — guard "
+                                "divisibility or derive the count "
+                                "from a global value")

@@ -1,0 +1,456 @@
+"""Cross-process SPMD divergence model (the GL4xx family's engine).
+
+Multi-host SPMD has one cardinal invariant: **every process issues the
+same collectives in the same order**.  The failure mode is a branch
+whose predicate only one host can evaluate truthfully — process index,
+a clock, a filesystem probe, a per-host counter — sitting above a
+collective: the processes disagree, the collective goes one-sided, and
+the pod deadlocks (the PR-7 ``last_saved_step`` class).  Pure AST, per
+file, never imports the linted code — same ground rules as the traced/
+thread/resource models.
+
+Three ingredients:
+
+1. **Collective reachability.**  Host-side multihost collectives
+   (``process_allgather``, ``sync_global_devices``,
+   ``broadcast_one_to_all``, ``make_array_from_process_local_data``)
+   and the in-program ``lax`` collectives, closed over same-file calls
+   (callables handed to ``tree_map``/combinators count as called), plus
+   the documented cross-file boundary methods
+   (:data:`COLLECTIVE_BOUNDARY_METHODS` — catalog note "multihost
+   collective boundaries").
+
+2. **Process-local taint.**  Expressions derived from sources only one
+   host can see (:data:`PROCESS_LOCAL_CALLS`,
+   :data:`DIVERGENT_ATTRS`), propagated through same-function name
+   assignments.  Everything else is assumed uniform — divergence
+   enters through sources, not through arithmetic.
+
+3. **The ``# replicated-by: <mechanism>`` convention.**  A predicate
+   the model cannot prove uniform is declared uniform by annotating
+   the branch line (or the assignment that produced the predicate's
+   value): ``# replicated-by: checkpoint-step-mirror``.  Mechanisms
+   named ``*-mirror`` additionally claim a mirroring WRITE exists
+   somewhere in the tree; that write site carries the provider twin
+   ``# replicates: <mechanism>`` and the repo-level ledger check
+   (:func:`mechanism_ledger`) fails any used-but-unprovided mirror —
+   so deleting the mirror write (reverting PR 7) fails GL401 even
+   though the consumer annotation lives in another file.
+
+Annotation binding copies the ``# guarded-by:`` physical-line rules
+(threads.py): a trailing comment binds to that statement's line span; a
+standalone comment binds to the next statement.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.tracing import (collect_functions, dotted, iter_scope,
+                                     last_seg)
+
+# consumer: declares the annotated predicate/value provably uniform
+_REPLICATED_RE = re.compile(
+    r"#.*?\breplicated-by\s*:\s*([A-Za-z0-9][A-Za-z0-9_.-]*)")
+# provider: the write site that implements a *-mirror mechanism
+_REPLICATES_RE = re.compile(
+    r"#.*?\breplicates\s*:\s*([A-Za-z0-9][A-Za-z0-9_.-]*)")
+# replay-boundary def marker (GL403): host fetch / checkpoint capture /
+# membership adoption is legal inside an annotated def
+_BOUNDARY_RE = re.compile(r"#.*?\breplay-boundary\s*:")
+
+# host-side multihost collectives: a call to one of these participates
+# in a cross-process rendezvous on the spot
+HOST_COLLECTIVES = {
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+    "make_array_from_process_local_data",
+}
+# in-program collectives (jax.lax / shard_map bodies).  Divergence for
+# these is a host phenomenon too: the hazard is the host branch deciding
+# WHETHER to dispatch the program that contains them.
+LAX_COLLECTIVES = {
+    "psum", "psum_scatter", "all_gather", "pmean", "pmin", "pmax",
+    "all_to_all", "ppermute", "pshuffle",
+}
+# cross-file collective boundaries, documented in the catalog notes
+# ("multihost collective boundaries"): methods whose multi-host
+# implementation allgathers even though a given file only sees the call
+COLLECTIVE_BOUNDARY_METHODS = {
+    "_do_checkpoint",       # DistriOptimizer override allgathers state
+    "_host_global",         # process_allgather wrapper
+    "_make_global",         # make_array_from_process_local_data wrapper
+    "_place_eval_input", "_place_eval_target", "_gather_eval_output",
+    "_place_train_block",   # ride _make_global/_host_global
+}
+
+# calls whose RESULT only one host can see — the divergence sources
+PROCESS_LOCAL_CALLS = {
+    "process_index",                                   # the archetype
+    "local_device_count", "local_devices", "addressable_devices",
+    "time", "monotonic", "perf_counter", "time_ns", "monotonic_ns",
+    "getpid", "gethostname", "getenv", "uname",
+    "exists", "isfile", "isdir", "listdir", "stat", "getmtime",
+    "getsize", "glob", "open",
+    "random", "randint", "randrange", "uniform", "choice", "shuffle",
+    "rand", "randn",
+}
+# calls that are uniform BY CONSTRUCTION even though they look dynamic
+UNIFORM_CALLS = {
+    "process_count", "device_count", "axis_size", "len", "isinstance",
+    "hasattr", "getattr", "int", "float", "bool", "str", "tuple",
+    "sorted", "min", "max", "sum", "abs", "type", "range",
+}
+# attribute names that are per-host state unless a mirror replicates
+# them — the model's seed registry (catalog note "per-host state"):
+# ``last_saved_step`` is written by whichever process performs the save
+# (process 0 alone, absent a mirror); ``triggered`` is a per-host signal
+# flag; ``environ`` reads are per-host by definition.
+DIVERGENT_ATTRS = {"last_saved_step", "triggered", "environ"}
+
+# GL403: calls that capture/fetch/adopt and therefore must sit at a
+# replay boundary; the boundary defs the catalog already names
+REPLAY_SINKS = {"capture_to_host", "device_get", "restore_into"}
+REPLAY_BOUNDARY_DEFS = {"_replay_block", "_do_checkpoint",
+                        "capture_to_host"}
+
+# GL404: consumers whose argument positions the dataset/schedule moves
+# by — a floored share must be exactness-guarded before it feeds one
+SCHEDULE_CONSUMERS = {"fast_forward_records"}
+
+
+def _comment_map(source: str) -> Dict[int, str]:
+    """line (1-based) → comment text, via the tokenizer.  Regex over raw
+    lines would treat a docstring that MENTIONS the convention (this
+    module's own, say) as an annotation; only COMMENT tokens count."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # pathological source: fall back to raw-line scanning (strings
+        # may leak through, but the file likely fails GL000 anyway)
+        for i, line in enumerate(source.splitlines(), start=1):
+            if "#" in line:
+                out[i] = line[line.index("#"):]
+    return out
+
+
+def _annotation_lines(source: str, regex: re.Pattern,
+                      comments: Optional[Dict[int, str]] = None,
+                      ) -> Dict[int, Set[str]]:
+    """line (1-based) → mechanisms bound there.  Trailing comments bind
+    to their own line; standalone comment lines bind to the NEXT
+    non-comment, non-blank line (the `# guarded-by:` convention)."""
+    if comments is None:
+        comments = _comment_map(source)
+    bound: Dict[int, Set[str]] = {}
+    lines = source.splitlines()
+    pending: Set[str] = set()
+    pending_standalone = False
+    for i, line in enumerate(lines, start=1):
+        stripped = line.strip()
+        toks = set(regex.findall(comments.get(i, "")))
+        if stripped.startswith("#"):
+            if toks:
+                pending |= toks
+                pending_standalone = True
+            continue
+        if not stripped:
+            continue
+        here = set(toks)
+        if pending_standalone:
+            here |= pending
+            pending = set()
+            pending_standalone = False
+        if here:
+            bound[i] = bound.get(i, set()) | here
+    return bound
+
+
+class SpmdModel:
+    """Per-file cross-process divergence model."""
+
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.path = path.replace("\\", "/")
+        self.funcs, self.by_name = collect_functions(tree)
+        # ---- annotations, bound by physical line
+        comments = _comment_map(source)
+        self.replicated_lines = _annotation_lines(source, _REPLICATED_RE,
+                                                  comments)
+        self.replicates_lines = _annotation_lines(source, _REPLICATES_RE,
+                                                  comments)
+        boundary_lines = {i for i, c in comments.items()
+                          if _BOUNDARY_RE.search(c)}
+        # a `# replay-boundary:` comment binds to the def whose header
+        # region (the contiguous comment block above the decorators, or
+        # the decorators..first-statement span itself) it touches
+        src_lines = source.splitlines()
+        comment_only = {i for i, line in enumerate(src_lines, start=1)
+                        if line.lstrip().startswith("#")}
+        self.boundary_defs: Set[int] = set()
+        for fi in self.funcs.values():
+            node = fi.node
+            first = min([node.lineno]
+                        + [d.lineno for d in node.decorator_list])
+            header = set(range(first, node.body[0].lineno))
+            j = first - 1
+            while j >= 1 and j in comment_only:
+                header.add(j)
+                j -= 1
+            if header & boundary_lines:
+                self.boundary_defs.add(id(node))
+        # ---- same-file collective closure
+        self.collective_ids: Set[int] = set()
+        self._close_collectives()
+
+    # ------------------------------------------------------ collectives
+    def _direct_collective_call(self, call: ast.Call) -> bool:
+        fn = last_seg(call.func)
+        if fn in HOST_COLLECTIVES:
+            return True
+        if fn in LAX_COLLECTIVES:
+            d = dotted(call.func) or ""
+            # bare names and lax./jax.lax. spellings; psum etc. are
+            # distinctive enough that the bare form counts too
+            return d == fn or d.startswith(("lax.", "jax.lax.",
+                                            "multihost_utils."))
+        return False
+
+    def _callees(self, node) -> Set[str]:
+        """Names this function calls, including callables handed to
+        tree_map/combinators (``tmap(self._host_global, x)`` calls
+        ``_host_global``)."""
+        out: Set[str] = set()
+        for n in iter_scope(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = last_seg(n.func)
+            if fn:
+                out.add(fn)
+            if fn in {"tmap", "tree_map", "tree_multimap", "map",
+                      "tree_map_with_path"}:
+                for a in n.args:
+                    s = last_seg(a)
+                    if s:
+                        out.add(s)
+        return out
+
+    def _close_collectives(self) -> None:
+        """Fixpoint: a function is collective-bearing when it calls a
+        collective directly, a boundary method, or a same-file
+        collective-bearing function."""
+        direct: Set[int] = set()
+        callee_map: Dict[int, Set[str]] = {}
+        for fid, fi in self.funcs.items():
+            callee_map[fid] = self._callees(fi.node)
+            for n in iter_scope(fi.node):
+                if isinstance(n, ast.Call) \
+                        and self._direct_collective_call(n):
+                    direct.add(fid)
+                    break
+        self.collective_ids = set(direct)
+        changed = True
+        while changed:
+            changed = False
+            bearing_names = {self.funcs[fid].name
+                             for fid in self.collective_ids}
+            for fid, callees in callee_map.items():
+                if fid in self.collective_ids:
+                    continue
+                if callees & bearing_names:
+                    self.collective_ids.add(fid)
+                    changed = True
+
+    def is_collective_call(self, call: ast.Call) -> bool:
+        """Direct collective, documented boundary method, or same-file
+        collective-bearing function."""
+        if self._direct_collective_call(call):
+            return True
+        fn = last_seg(call.func)
+        if fn in COLLECTIVE_BOUNDARY_METHODS:
+            return True
+        return any(id(fi.node) in self.collective_ids
+                   for fi in self.by_name.get(fn or "", []))
+
+    def collective_calls(self, func_node) -> List[ast.Call]:
+        return [n for n in iter_scope(func_node)
+                if isinstance(n, ast.Call) and self.is_collective_call(n)]
+
+    # ------------------------------------------------- replicated-by uses
+    def _stmt_lines(self, node: ast.stmt) -> range:
+        """Physical lines of a statement HEADER (test/decorators span,
+        not the body) an annotation may bind to."""
+        if isinstance(node, (ast.If, ast.While)):
+            end = getattr(node.test, "end_lineno", node.lineno)
+        else:
+            end = getattr(node, "end_lineno", node.lineno)
+        return range(node.lineno, end + 1)
+
+    def declared_replicated(self, stmt: ast.stmt) -> Set[str]:
+        """Mechanisms bound to this statement's header lines."""
+        out: Set[str] = set()
+        for ln in self._stmt_lines(stmt):
+            out |= self.replicated_lines.get(ln, set())
+        return out
+
+    def declared_names(self, func_node) -> Tuple[Set[str], Set[str]]:
+        """(names, attrs) declared uniform at their assignment site via
+        a `# replicated-by:` annotation inside this function."""
+        names: Set[str] = set()
+        attrs: Set[str] = set()
+        for n in iter_scope(func_node):
+            if not isinstance(n, (ast.Assign, ast.AnnAssign,
+                                  ast.AugAssign)):
+                continue
+            if not self.declared_replicated(n):
+                continue
+            targets = (n.targets if isinstance(n, ast.Assign)
+                       else [n.target])
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    attrs.add(t.attr)
+        return names, attrs
+
+    # -------------------------------------------------- uniformity check
+    def _call_is_process_local(self, call: ast.Call) -> bool:
+        fn = last_seg(call.func)
+        if fn in UNIFORM_CALLS:
+            return False
+        if fn in PROCESS_LOCAL_CALLS:
+            d = dotted(call.func) or fn or ""
+            # bare `open`/`random` style builtins and dotted time.*/
+            # os.*/random.*/np.random.* all count; uniform-looking
+            # method names (`.exists` on a set?) are rare enough in
+            # predicate position that the name match is the model
+            return True if d else False
+        return False
+
+    def is_uniform(self, expr: ast.AST, fi_node,
+                   local_taint: Optional[Set[str]] = None,
+                   declared: Optional[Tuple[Set[str], Set[str]]] = None,
+                   ) -> bool:
+        """True when every process provably computes the same value."""
+        taint = local_taint if local_taint is not None \
+            else self.process_local_names(fi_node)
+        decl_names, decl_attrs = declared if declared is not None \
+            else self.declared_names(fi_node)
+
+        def uni(e) -> bool:
+            if e is None or isinstance(e, (ast.Constant, ast.JoinedStr,
+                                           ast.Lambda)):
+                return True
+            if isinstance(e, ast.Name):
+                return e.id in decl_names or e.id not in taint
+            if isinstance(e, ast.Attribute):
+                if e.attr in decl_attrs:
+                    return True
+                if e.attr in DIVERGENT_ATTRS:
+                    return False
+                return uni(e.value)
+            if isinstance(e, ast.Subscript):
+                return uni(e.value) and uni(e.slice)
+            if isinstance(e, ast.Compare):
+                # `is None` / `is not None` checks are structural
+                return uni(e.left) and all(uni(c) for c in e.comparators)
+            if isinstance(e, (ast.BoolOp, ast.Tuple, ast.List, ast.Set)):
+                vals = e.values if isinstance(e, ast.BoolOp) else e.elts
+                return all(uni(v) for v in vals)
+            if isinstance(e, ast.BinOp):
+                return uni(e.left) and uni(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return uni(e.operand)
+            if isinstance(e, ast.IfExp):
+                return uni(e.test) and uni(e.body) and uni(e.orelse)
+            if isinstance(e, ast.Call):
+                if self._call_is_process_local(e):
+                    return False
+                return (uni(e.func) if isinstance(e.func, ast.Attribute)
+                        else True) and all(uni(a) for a in e.args) \
+                    and all(uni(k.value) for k in e.keywords)
+            if isinstance(e, ast.Starred):
+                return uni(e.value)
+            return True
+
+        return uni(expr)
+
+    def process_local_names(self, func_node) -> Set[str]:
+        """Names in this function assigned from a process-local source
+        (one forward pass; enough for straight-line driver code)."""
+        decl_names, decl_attrs = self.declared_names(func_node)
+        taint: Set[str] = set()
+
+        def divergent(e) -> bool:
+            for n in ast.walk(e):
+                if isinstance(n, ast.Call) \
+                        and self._call_is_process_local(n):
+                    return True
+                if isinstance(n, ast.Attribute) \
+                        and n.attr in DIVERGENT_ATTRS \
+                        and n.attr not in decl_attrs:
+                    return True
+                if isinstance(n, ast.Name) and n.id in taint \
+                        and n.id not in decl_names:
+                    return True
+            return False
+
+        for n in iter_scope(func_node):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = n.value
+                if value is None:
+                    continue
+                if self.declared_replicated(n):
+                    continue  # annotation beats taint at the same site
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                if divergent(value):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            taint.add(t.id)
+        return taint
+
+    # -------------------------------------------------------- GL403 bits
+    def is_boundary_def(self, func_node) -> bool:
+        return (id(func_node) in self.boundary_defs
+                or getattr(func_node, "name", None)
+                in REPLAY_BOUNDARY_DEFS)
+
+    # ------------------------------------------------- mechanism ledger
+    def mechanism_uses(self) -> Set[str]:
+        out: Set[str] = set()
+        for toks in self.replicated_lines.values():
+            out |= toks
+        return out
+
+    def mechanism_providers(self) -> Set[str]:
+        out: Set[str] = set()
+        for toks in self.replicates_lines.values():
+            out |= toks
+        return out
+
+
+def mechanism_ledger(models: List[SpmdModel]
+                     ) -> List[Tuple[str, int, str]]:
+    """Repo-level check behind GL401's mirror contract: every
+    ``*-mirror`` mechanism some file RELIES on (``# replicated-by:``)
+    must have at least one provider write site (``# replicates:``)
+    somewhere in the scanned set.  Returns ``(path, line, mechanism)``
+    per unprovided use — deleting a mirror write (the PR-7 revert)
+    surfaces here."""
+    provided: Set[str] = set()
+    for m in models:
+        provided |= m.mechanism_providers()
+    missing: List[Tuple[str, int, str]] = []
+    for m in models:
+        for line, toks in sorted(m.replicated_lines.items()):
+            for mech in sorted(toks):
+                if mech.endswith("-mirror") and mech not in provided:
+                    missing.append((m.path, line, mech))
+    return missing

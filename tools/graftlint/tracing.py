@@ -61,9 +61,11 @@ OPTIM_TRACED_METHODS = {"update"}
 # parameters that are never tracers under the repo's contracts
 UNTAINTED_PARAMS = {"self", "cls", "training"}
 
-# attributes that are static metadata even on a tracer
+# attributes that are static metadata even on a tracer.
+# dense_shape/n_rows: COOBatch pytree AUX metadata (nn/sparse.py) —
+# carried outside the leaves, so they are host ints on every trace
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "name", "aval",
-                "weak_type"}
+                "weak_type", "dense_shape", "n_rows"}
 
 # calls that return host/static values regardless of argument taint
 STATIC_CALLS = {"len", "isinstance", "issubclass", "getattr", "hasattr",
