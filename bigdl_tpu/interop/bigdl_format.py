@@ -304,6 +304,36 @@ def _build(node: dict) -> Module:
             return nn.TemporalConvolution(
                 int(a["inputFrameSize"]), int(a["outputFrameSize"]),
                 int(a["kernelW"]), int(a.get("strideW", 1)), name=name)
+        if t in ("QuantizedLinear", "QuantizedSpatialConvolution"):
+            # quantized twins reconstruct straight from the node's
+            # tensors (their init() is empty, so the generic
+            # weights-to-params pass has nothing to do for them)
+            from bigdl_tpu.nn.quantized import (
+                QuantizedLinear, QuantizedSpatialConvolution)
+            ps = [p for p in node["parameters"] if p is not None]
+            if len(ps) < 2:
+                raise ValueError(
+                    f"quantized module {node['name']!r}: expected "
+                    f"(weight_q, weight_scale[, bias]) tensors, got "
+                    f"{len(ps)}")
+            qmode = (a.get("quantMode") or ["weight_only"])[0]
+            wq = np.asarray(ps[0], np.float32).astype(np.int8)
+            ws = np.asarray(ps[1], np.float32)
+            b = np.asarray(ps[2], np.float32) if len(ps) > 2 else None
+            if t == "QuantizedLinear":
+                return QuantizedLinear(wq, ws, b, name=name, mode=qmode)
+            conv = nn.SpatialConvolution(
+                int(a["nInputPlane"]), int(a["nOutputPlane"]),
+                int(a["kernelW"]), int(a["kernelH"]),
+                int(a.get("strideW", 1)), int(a.get("strideH", 1)),
+                int(a.get("padW", 0)), int(a.get("padH", 0)),
+                n_group=int(a.get("nGroup", 1)),
+                with_bias=bool(a.get("withBias", True)),
+                dilation_w=int(a.get("dilationW", 1)),
+                dilation_h=int(a.get("dilationH", 1)),
+                format=a.get("format", "NCHW"))
+            return QuantizedSpatialConvolution(conv, wq, ws, b,
+                                               name=name, mode=qmode)
         simple = {"ReLU": nn.ReLU, "Tanh": nn.Tanh, "Sigmoid": nn.Sigmoid,
                   "LogSoftMax": nn.LogSoftMax, "SoftMax": nn.SoftMax,
                   "Identity": nn.Identity, "Flatten": nn.Flatten,
@@ -598,6 +628,33 @@ class _Exporter:
                     "outputFrameSize": _enc_attr_int(m.output_frame_size),
                     "kernelW": _enc_attr_int(m.kernel_w),
                     "strideW": _enc_attr_int(m.stride_w)}
+        # int8 quantized twins (reference quantized/Linear.scala etc.):
+        # structural attrs mirror the float layer, plus the activation
+        # mode so a loaded model keeps its weight_only/dynamic choice
+        if t == "QuantizedLinear":
+            o, i = m.weight_q.shape
+            return {**out,
+                    "inputSize": _enc_attr_int(i),
+                    "outputSize": _enc_attr_int(o),
+                    "withBias": _enc_attr_bool(m.bias is not None),
+                    "quantMode": _enc_attr_str_array([m.mode])}
+        if t == "QuantizedSpatialConvolution":
+            c = m.conv
+            return {**out,
+                    "nInputPlane": _enc_attr_int(c.n_input_plane),
+                    "nOutputPlane": _enc_attr_int(c.n_output_plane),
+                    "kernelW": _enc_attr_int(c.kernel[1]),
+                    "kernelH": _enc_attr_int(c.kernel[0]),
+                    "strideW": _enc_attr_int(c.stride[1]),
+                    "strideH": _enc_attr_int(c.stride[0]),
+                    "padW": _enc_attr_int(c.pad[1]),
+                    "padH": _enc_attr_int(c.pad[0]),
+                    "nGroup": _enc_attr_int(c.n_group),
+                    "withBias": _enc_attr_bool(m.bias is not None),
+                    "format": _enc_attr_format(c.format),
+                    "dilationW": _enc_attr_int(c.dilation[1]),
+                    "dilationH": _enc_attr_int(c.dilation[0]),
+                    "quantMode": _enc_attr_str_array([m.mode])}
         return out
 
     def encode(self, m: Module, params, state, pre=(), nxt=(),
@@ -727,6 +784,16 @@ class _Exporter:
     @staticmethod
     def module_tensors(m: Module, params) -> List[np.ndarray]:
         t = type(m).__name__
+        if t in ("QuantizedLinear", "QuantizedSpatialConvolution"):
+            # quantized leaves carry buffers on the object (init() is
+            # empty).  int8 panel values are small ints (-127..127),
+            # exactly representable in the f32 tensor wire format —
+            # the round trip is lossless
+            out = [np.asarray(m.weight_q, np.float32),
+                   np.asarray(m.weight_scale, np.float32)]
+            if m.bias is not None:
+                out.append(np.asarray(m.bias, np.float32))
+            return out
         if not params or t in ("Sequential", "Concat", "ConcatTable"):
             return []
         if t == "SpatialConvolution":

@@ -46,6 +46,67 @@ def _load(args):
     raise SystemExit(f"unknown source format {args.src_fmt}")
 
 
+def _probe_input(model):
+    """Derive a forward-probe batch from the first weighted layer.
+
+    Walks the module tree in declaration order and shapes a small f32
+    batch for the first ``Linear`` ((4, input_size)) or
+    ``SpatialConvolution`` ((2, C, H, W) honoring the layer's data
+    format) it finds.  Returns ``None`` when the tree has neither
+    (e.g. embedding-only models) — the parity check is then skipped
+    loudly rather than guessed at."""
+    import numpy as np
+
+    from bigdl_tpu.nn.layers import Linear, SpatialConvolution
+    from bigdl_tpu.nn.module import Container
+
+    queue = [model]
+    while queue:
+        m = queue.pop(0)
+        if isinstance(m, Linear):
+            shape = (4, m.input_size)
+        elif isinstance(m, SpatialConvolution):
+            kh, kw = m.kernel
+            h, w = max(8, kh), max(8, kw)
+            shape = ((2, m.n_input_plane, h, w) if m.format == "NCHW"
+                     else (2, h, w, m.n_input_plane))
+        elif isinstance(m, Container):
+            queue = list(m.modules) + queue
+            continue
+        else:
+            continue
+        return np.random.default_rng(0).standard_normal(shape) \
+            .astype(np.float32)
+    return None
+
+
+def _validate_quantized(source, quantized, tol):
+    """Forward-parity gate for ``--quantize``: the int8 model must agree
+    with the float source on a probe batch within ``tol`` relative
+    error, or the conversion aborts before anything is saved.  (The CLI
+    used to quantize blind — a panel with a saturated outlier channel
+    would serialize garbage silently.)"""
+    import numpy as np
+
+    x = _probe_input(source)
+    if x is None:
+        print("quantize parity: no Linear/SpatialConvolution in the "
+              "model tree; forward check skipped")
+        return None
+    y0 = np.asarray(source.forward(x), dtype=np.float32)
+    y1 = np.asarray(quantized.forward(x), dtype=np.float32)
+    denom = max(float(np.max(np.abs(y0))), 1e-6)
+    err = float(np.max(np.abs(y1 - y0))) / denom
+    if err > tol:
+        raise SystemExit(
+            f"--quantize parity check FAILED: max relative error "
+            f"{err:.4f} > tolerance {tol} — refusing to save the "
+            f"quantized model (raise --quantize-tolerance to override)")
+    print(f"quantize parity: max relative error {err:.4f} "
+          f"(tolerance {tol})")
+    return err
+
+
 def _save(model, args):
     if args.dst_fmt == "bigdl":
         from bigdl_tpu.interop import save_bigdl_module
@@ -80,6 +141,14 @@ def main(argv=None):
     p.add_argument("--quantize", action="store_true",
                    help="int8-quantize before saving (bigdl target only, "
                         "reference ConvertModel.scala:40)")
+    p.add_argument("--quantize-mode", dest="quantize_mode",
+                   choices=["weight_only", "dynamic"],
+                   help="int8 activation mode (default: "
+                        "Config.int8_activation_mode)")
+    p.add_argument("--quantize-tolerance", dest="quantize_tolerance",
+                   type=float, default=0.05,
+                   help="max relative forward error accepted by the "
+                        "--quantize parity check (default 0.05)")
     args = p.parse_args(argv)
 
     model = _load(args)
@@ -87,7 +156,9 @@ def main(argv=None):
         if args.dst_fmt != "bigdl":
             raise SystemExit("--quantize is only supported with --to bigdl")
         from bigdl_tpu.nn.quantized import quantize
-        model = quantize(model)
+        source = model
+        model = quantize(model, mode=args.quantize_mode)
+        _validate_quantized(source, model, args.quantize_tolerance)
     _save(model, args)
     print(f"converted {args.input} ({args.src_fmt}) -> "
           f"{args.output} ({args.dst_fmt})")

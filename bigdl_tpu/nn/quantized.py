@@ -6,17 +6,35 @@ converts Linear/SpatialConvolution/… to quantized twins) +
 weights per-output-channel, activations quantized on the fly, int32
 accumulate, dequantize).
 
-TPU redesign (SURVEY §7 stage 9): the BigQuant JNI kernels become
-``lax.dot_general``/``lax.conv_general_dilated`` on int8 operands with
-``preferred_element_type=int32`` — XLA lowers that onto the MXU's int8
-path natively.  Scheme matches the reference's:
+TPU redesign (SURVEY §7 stage 9, reworked in the int8 speed-path PR):
+the BigQuant JNI kernels become the fused Pallas mixed-precision GEMM
+in ``ops/pallas_int8_gemm.py`` — int8 weight panel VMEM-resident,
+per-output-channel f32 scales, dequantize + bias fused in-register —
+behind the standard ``kernel_impl`` gate with a bitwise-identical XLA
+fallback.  Scheme still matches the reference's:
 
 - weights: symmetric per-output-channel int8
   (``scale_o = max|W_o| / 127``);
-- activations: symmetric per-tensor dynamic int8, the max computed on the
-  fly per batch exactly like BigQuant's runtime quantization;
-- accumulation int32, dequantize with ``x_scale * w_scale_o``, add the
-  f32 bias.
+- activations, per-layer ``mode`` (``Config.int8_activation_mode``
+  default, ``quantize(model, mode=...)`` override):
+
+  - ``"weight_only"``: keep f32/bf16 activations, f32 MXU accumulation
+    against the int8 panel — no activation quantization error; the
+    serving default (the weight panel bytes are what small-batch
+    inference pays for);
+  - ``"dynamic"``: symmetric per-tensor int8 on the fly exactly like
+    BigQuant's runtime quantization, int32 accumulate, dequantize with
+    ``x_scale * w_scale_o``;
+
+- f32 bias added after dequantization either way.
+
+``QuantizedSpatialConvolution`` reduces onto the same GEMM (1x1
+reshape / im2col patches) when ``n_group == 1`` and the kernel's
+``supported()`` gate passes; otherwise it keeps the direct
+``lax.conv_general_dilated`` simulation (mode-aware).  Conversion
+semantics and pytree/exporter traversal are unchanged — quantized
+leaves still carry their buffers on the object and ``init()`` returns
+empty params.
 """
 
 from __future__ import annotations
@@ -31,6 +49,26 @@ from jax import lax
 
 from bigdl_tpu.nn.layers import Linear, SpatialConvolution, _conv_dims
 from bigdl_tpu.nn.module import Container, Module
+from bigdl_tpu.ops import pallas_int8_gemm
+from bigdl_tpu.ops.pallas_int8_gemm import MODES, int8_matmul
+
+# activation quantization lives with the kernel now (single definition
+# shared by kernel body and fallback); this alias keeps the historical
+# nn.quantized surface working
+_dyn_quantize = pallas_int8_gemm.dyn_quantize
+
+
+def _default_mode(mode: Optional[str]) -> str:
+    """Resolve the per-layer activation mode: explicit arg >
+    ``Config.int8_activation_mode`` (env ``BIGDL_TPU_INT8_ACTIVATION_
+    MODE``) > the "weight_only" dataclass default."""
+    if mode is None:
+        from bigdl_tpu.utils.config import get_config
+        mode = get_config().int8_activation_mode
+    if mode not in MODES:
+        raise ValueError(
+            f"int8 activation mode must be one of {MODES}, got {mode!r}")
+    return mode
 
 
 def _quantize_symmetric(w: np.ndarray, axis=None):
@@ -41,98 +79,165 @@ def _quantize_symmetric(w: np.ndarray, axis=None):
     return q, np.asarray(scale, np.float32)
 
 
-def _dyn_quantize(x: jnp.ndarray):
-    """Per-tensor dynamic activation quantization (traced; scale is a
-    runtime value like BigQuant's on-the-fly quantization)."""
-    amax = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
-    scale = amax / 127.0
-    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-    return q, scale
-
-
-def _int8_linear(x, wq, wscale, bias=None):
-    """Dynamic-int8 ``x @ W.T + b`` on the MXU int8 path."""
-    xq, xs = _dyn_quantize(x)
-    acc = lax.dot_general(xq, wq.T,
-                          dimension_numbers=(((1,), (0,)), ((), ())),
-                          preferred_element_type=jnp.int32)
-    y = acc.astype(jnp.float32) * (xs * wscale.reshape(-1)[None])
-    if bias is not None:
-        y = y + bias
-    return y
+def _int8_linear(x, wq, wscale, bias=None, *, mode: str = "weight_only",
+                 impl=None):
+    """``x @ W.T + b`` through the kernel-backed quantized GEMM
+    (``ops/pallas_int8_gemm.int8_matmul`` — pallas where supported,
+    bitwise-identical XLA fallback otherwise)."""
+    return int8_matmul(x, wq, wscale, bias, mode=mode, impl=impl)
 
 
 class QuantizedLinear(Module):
     """int8 Linear (reference ``quantized/Linear.scala``)."""
 
     def __init__(self, weight_q: np.ndarray, weight_scale: np.ndarray,
-                 bias: Optional[np.ndarray], name: Optional[str] = None):
+                 bias: Optional[np.ndarray], name: Optional[str] = None,
+                 mode: Optional[str] = None, impl: Optional[str] = None):
         super().__init__(name)
         self.weight_q = jnp.asarray(weight_q)          # (out, in) int8
         self.weight_scale = jnp.asarray(weight_scale)  # (out, 1)
         self.bias = None if bias is None else jnp.asarray(bias)
+        self.mode = _default_mode(mode)
+        self.impl = impl
 
     @staticmethod
-    def from_linear(m: Linear, params) -> "QuantizedLinear":
+    def from_linear(m: Linear, params, mode: Optional[str] = None,
+                    impl: Optional[str] = None) -> "QuantizedLinear":
         wq, ws = _quantize_symmetric(np.asarray(params["weight"]), axis=1)
         b = np.asarray(params["bias"]) if "bias" in params else None
-        return QuantizedLinear(wq, ws, b, name=m.name)
+        return QuantizedLinear(wq, ws, b, name=m.name, mode=mode,
+                               impl=impl)
 
     def init(self, rng):
         return {}, {}
 
     def apply(self, params, state, input, *, training=False, rng=None):
         return _int8_linear(input, self.weight_q, self.weight_scale,
-                            self.bias), state
+                            self.bias, mode=self.mode,
+                            impl=self.impl), state
 
 
 class QuantizedSpatialConvolution(Module):
-    """int8 conv (reference ``quantized/SpatialConvolution.scala``)."""
+    """int8 conv (reference ``quantized/SpatialConvolution.scala``).
+
+    Reduces onto the shared int8 GEMM — a 1x1 kernel is a plain
+    reshape, anything else goes through im2col
+    (``lax.conv_general_dilated_patches``) — whenever ``n_group == 1``,
+    the resolved ``kernel_impl`` is pallas and the flattened
+    (C*kh*kw, O) panel passes the GEMM's ``supported()`` gate.  All
+    other shapes keep the direct ``lax.conv_general_dilated``
+    simulation with the same per-mode quantized math.
+    """
 
     def __init__(self, conv: SpatialConvolution, weight_q, weight_scale,
-                 bias, name: Optional[str] = None):
+                 bias, name: Optional[str] = None,
+                 mode: Optional[str] = None, impl: Optional[str] = None):
         super().__init__(name or conv.name)
         self.conv = conv
         self.weight_q = jnp.asarray(weight_q)          # OIHW int8
         self.weight_scale = jnp.asarray(weight_scale)  # (O,1,1,1)
         self.bias = None if bias is None else jnp.asarray(bias)
+        self.mode = _default_mode(mode)
+        self.impl = impl
 
     @staticmethod
-    def from_conv(m: SpatialConvolution, params
+    def from_conv(m: SpatialConvolution, params,
+                  mode: Optional[str] = None, impl: Optional[str] = None
                   ) -> "QuantizedSpatialConvolution":
         wq, ws = _quantize_symmetric(np.asarray(params["weight"]),
                                      axis=(1, 2, 3))
         b = np.asarray(params["bias"]) if "bias" in params else None
-        return QuantizedSpatialConvolution(m, wq, ws, b)
+        return QuantizedSpatialConvolution(m, wq, ws, b, mode=mode,
+                                           impl=impl)
 
     def init(self, rng):
         return {}, {}
 
-    def apply(self, params, state, input, *, training=False, rng=None):
+    def _padding(self):
+        ph, pw_ = self.conv.pad
+        return "SAME" if (ph == -1 or pw_ == -1) else ((ph, ph),
+                                                       (pw_, pw_))
+
+    def _gemm_engages(self, batch_hint: int, x_dtype) -> bool:
+        """Host-side (trace-time) decision: route through the GEMM only
+        when the pallas kernel would actually engage — the im2col
+        reshuffle is pure overhead in front of an XLA fallback."""
+        from bigdl_tpu.ops import resolve_kernel_impl
         m = self.conv
-        xq, xs = _dyn_quantize(input)
+        if m.n_group != 1:
+            return False
+        if resolve_kernel_impl(self.impl) != "pallas":
+            return False
+        O, C, kh, kw = self.weight_q.shape
+        return pallas_int8_gemm.supported(max(batch_hint, 1), C * kh * kw,
+                                          O, x_dtype, self.mode)
+
+    def _apply_gemm(self, x):
+        """im2col / 1x1 reduction onto the shared int8 GEMM."""
+        m = self.conv
+        O, C, kh, kw = self.weight_q.shape
+        patches = lax.conv_general_dilated_patches(
+            x, filter_shape=(kh, kw), window_strides=m.stride,
+            padding=self._padding(), rhs_dilation=m.dilation,
+            dimension_numbers=_conv_dims(m.format))
+        # patches put the C*kh*kw unrolled taps in the spec's feature
+        # dim (channel-major, matching OIHW.reshape(O, -1) flattening)
+        if m.format == "NCHW":
+            n, k, ho, wo = patches.shape
+            rows = jnp.transpose(patches, (0, 2, 3, 1)).reshape(-1, k)
+        else:
+            n, ho, wo, k = patches.shape
+            rows = patches.reshape(-1, k)
+        y = int8_matmul(rows, self.weight_q.reshape(O, -1),
+                        self.weight_scale, self.bias, mode=self.mode,
+                        impl=self.impl)
+        y = y.reshape(n, ho, wo, O)
+        if m.format == "NCHW":
+            y = jnp.transpose(y, (0, 3, 1, 2))
+        return y
+
+    def _apply_sim(self, x):
+        """Direct ``lax.conv_general_dilated`` simulation of the same
+        quantized math (the pre-kernel path, kept for grouped convs and
+        shapes the GEMM gate rejects)."""
+        m = self.conv
         w = self.weight_q
         if m.format == "NHWC":
             w = jnp.transpose(w, (2, 3, 1, 0))
-        ph, pw_ = m.pad
-        padding = "SAME" if (ph == -1 or pw_ == -1) else ((ph, ph),
-                                                          (pw_, pw_))
-        acc = lax.conv_general_dilated(
-            xq, w, window_strides=m.stride, padding=padding,
-            rhs_dilation=m.dilation,
-            dimension_numbers=_conv_dims(m.format),
-            feature_group_count=m.n_group,
-            preferred_element_type=jnp.int32)
+        padding = self._padding()
         ws = self.weight_scale.reshape(-1)
+        if self.mode == "dynamic":
+            xq, xs = _dyn_quantize(x)
+            acc = lax.conv_general_dilated(
+                xq, w, window_strides=m.stride, padding=padding,
+                rhs_dilation=m.dilation,
+                dimension_numbers=_conv_dims(m.format),
+                feature_group_count=m.n_group,
+                preferred_element_type=jnp.int32)
+            scale = xs * ws
+        else:  # weight_only: f32 accumulation, no activation error
+            acc = lax.conv_general_dilated(
+                x.astype(jnp.float32), w.astype(jnp.float32),
+                window_strides=m.stride, padding=padding,
+                rhs_dilation=m.dilation,
+                dimension_numbers=_conv_dims(m.format),
+                feature_group_count=m.n_group,
+                preferred_element_type=jnp.float32)
+            scale = ws
         if m.format == "NCHW":
-            y = acc.astype(jnp.float32) * (xs * ws)[None, :, None, None]
+            y = acc.astype(jnp.float32) * scale[None, :, None, None]
             if self.bias is not None:
                 y = y + self.bias[None, :, None, None]
         else:
-            y = acc.astype(jnp.float32) * (xs * ws)[None, None, None, :]
+            y = acc.astype(jnp.float32) * scale[None, None, None, :]
             if self.bias is not None:
                 y = y + self.bias[None, None, None, :]
-        return y, state
+        return y
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        if self._gemm_engages(input.shape[0], input.dtype):
+            return self._apply_gemm(input), state
+        return self._apply_sim(input), state
 
 
 # --------------------------------------------------- quantized recurrent
@@ -143,10 +248,13 @@ class _QuantizedCellBase(Module):
     """Module subclass so spec_children tree-walkers (regularizers,
     sharding specs, exporters) traverse quantized cells like any leaf."""
 
-    def __init__(self, cell):
+    def __init__(self, cell, mode: Optional[str] = None,
+                 impl: Optional[str] = None):
         super().__init__(f"Quantized{type(cell).__name__}")
         self.cell = cell
         self.hidden_size = cell.hidden_size
+        self.mode = _default_mode(mode)
+        self.impl = impl
 
     def initial_hidden(self, batch_size):
         return self.cell.initial_hidden(batch_size)
@@ -154,12 +262,17 @@ class _QuantizedCellBase(Module):
     def init(self, rng):
         return {}, {}
 
+    def _proj(self, x, wq, ws, bias):
+        return _int8_linear(x, wq, ws, bias, mode=self.mode,
+                            impl=self.impl)
+
 
 class QuantizedLSTM(_QuantizedCellBase):
     """int8 gate projection LSTM cell."""
 
-    def __init__(self, cell, params):
-        super().__init__(cell)
+    def __init__(self, cell, params, mode: Optional[str] = None,
+                 impl: Optional[str] = None):
+        super().__init__(cell, mode=mode, impl=impl)
         self.wq, self.ws = _quantize_symmetric(
             np.asarray(params["weight"]), axis=1)
         self.wq = jnp.asarray(self.wq)
@@ -168,8 +281,8 @@ class QuantizedLSTM(_QuantizedCellBase):
 
     def step(self, params, x_t, hidden):
         h, c = hidden
-        z = _int8_linear(jnp.concatenate([x_t, h], axis=-1), self.wq,
-                         self.ws, self.bias)
+        z = self._proj(jnp.concatenate([x_t, h], axis=-1), self.wq,
+                       self.ws, self.bias)
         i, f, g, o = jnp.split(z, 4, axis=-1)
         i = jax.nn.sigmoid(i)
         f = jax.nn.sigmoid(f + self.cell.forget_bias)
@@ -184,8 +297,9 @@ class QuantizedGRU(_QuantizedCellBase):
     """int8 gate + candidate projections GRU cell (Keras/reference
     convention: reset applied to h BEFORE the candidate projection)."""
 
-    def __init__(self, cell, params):
-        super().__init__(cell)
+    def __init__(self, cell, params, mode: Optional[str] = None,
+                 impl: Optional[str] = None):
+        super().__init__(cell, mode=mode, impl=impl)
         self.gq, self.gs = _quantize_symmetric(
             np.asarray(params["w_gates"]), axis=1)
         self.cq, self.cs = _quantize_symmetric(
@@ -196,10 +310,10 @@ class QuantizedGRU(_QuantizedCellBase):
         self.b_cand = jnp.asarray(params["b_cand"])
 
     def step(self, params, x_t, h):
-        z = _int8_linear(jnp.concatenate([x_t, h], axis=-1), self.gq,
-                         self.gs, self.b_gates)
+        z = self._proj(jnp.concatenate([x_t, h], axis=-1), self.gq,
+                       self.gs, self.b_gates)
         r, u = jnp.split(jax.nn.sigmoid(z), 2, axis=-1)
-        cand = jnp.tanh(_int8_linear(
+        cand = jnp.tanh(self._proj(
             jnp.concatenate([x_t, r * h], axis=-1), self.cq, self.cs,
             self.b_cand))
         h_new = u * h + (1 - u) * cand
@@ -209,8 +323,9 @@ class QuantizedGRU(_QuantizedCellBase):
 class QuantizedRnnCell(_QuantizedCellBase):
     """int8 simple RNN cell."""
 
-    def __init__(self, cell, params):
-        super().__init__(cell)
+    def __init__(self, cell, params, mode: Optional[str] = None,
+                 impl: Optional[str] = None):
+        super().__init__(cell, mode=mode, impl=impl)
         w = np.concatenate([np.asarray(params["w_ih"]),
                             np.asarray(params["w_hh"])], axis=1)
         self.wq, self.ws = _quantize_symmetric(w, axis=1)
@@ -218,31 +333,39 @@ class QuantizedRnnCell(_QuantizedCellBase):
         self.bias = jnp.asarray(params["bias"])
 
     def step(self, params, x_t, h):
-        z = _int8_linear(jnp.concatenate([x_t, h], axis=-1), self.wq,
-                         self.ws, self.bias)
+        z = self._proj(jnp.concatenate([x_t, h], axis=-1), self.wq,
+                       self.ws, self.bias)
         h_new = self.cell.activation(z)
         return h_new, h_new
 
 
-def _quantize_cell(cell, params):
+def _quantize_cell(cell, params, mode=None, impl=None):
     from bigdl_tpu.nn.recurrent import GRU, LSTM, RnnCell
     if type(cell) is LSTM:
-        return QuantizedLSTM(cell, params)
+        return QuantizedLSTM(cell, params, mode=mode, impl=impl)
     if type(cell) is GRU:
-        return QuantizedGRU(cell, params)
+        return QuantizedGRU(cell, params, mode=mode, impl=impl)
     if type(cell) is RnnCell:
-        return QuantizedRnnCell(cell, params)
+        return QuantizedRnnCell(cell, params, mode=mode, impl=impl)
     return None
 
 
-def quantize(model: Module) -> Module:
+def quantize(model: Module, mode: Optional[str] = None,
+             impl: Optional[str] = None) -> Module:
     """Post-training quantization of a materialized (eager) module tree —
     the ``model.quantize()`` entry point (reference
     ``Quantization.quantize``).  Returns a NEW module; the original is
     untouched.  Linear/SpatialConvolution and the LSTM/GRU/RnnCell gate
     projections become int8; everything else is kept (running on f32
-    activations exactly like the reference's mixed graph)."""
+    activations exactly like the reference's mixed graph).
+
+    ``mode`` stamps the activation mode on every converted layer
+    (``"weight_only"`` / ``"dynamic"``; None = the
+    ``Config.int8_activation_mode`` default), ``impl`` the per-layer
+    kernel_impl override.  Idempotent: already-quantized leaves are not
+    Linear/SpatialConvolution instances, so a second pass keeps them."""
     from bigdl_tpu.nn.recurrent import BiRecurrent, Recurrent
+    mode = _default_mode(mode)  # resolve ONCE so the tree is uniform
     model._ensure_init()
 
     def convert(m: Module, params) -> Module:
@@ -252,7 +375,7 @@ def quantize(model: Module) -> Module:
                            for i, c in enumerate(m.modules)]
             return out
         if isinstance(m, Recurrent):
-            qc = _quantize_cell(m.cell, params)
+            qc = _quantize_cell(m.cell, params, mode=mode, impl=impl)
             if qc is not None:
                 out = copy.copy(m)
                 out.cell = qc
@@ -264,10 +387,13 @@ def quantize(model: Module) -> Module:
             out.bwd = convert(m.bwd, params.get("bwd", {}))
             return out
         if isinstance(m, Linear):
-            return QuantizedLinear.from_linear(m, params)
+            return QuantizedLinear.from_linear(m, params, mode=mode,
+                                               impl=impl)
         if isinstance(m, SpatialConvolution) and type(m) is \
                 SpatialConvolution:
-            return QuantizedSpatialConvolution.from_conv(m, params)
+            return QuantizedSpatialConvolution.from_conv(m, params,
+                                                         mode=mode,
+                                                         impl=impl)
         return m
 
     q = convert(model, model._params)

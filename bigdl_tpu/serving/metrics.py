@@ -86,6 +86,34 @@ class ServingMetrics:
         # the unbiased throughput denominator (module docstring)
         self._t_first_submit: Optional[float] = None
         self._t_last_done: Optional[float] = None
+        # weights dtype of the served model (int8 speed-path PR): the
+        # gauge is PRE-created here — one fixed metric name per service
+        # registry, value-coded — so the Prometheus scrape schema is
+        # bounded up front instead of growing a label per dtype string.
+        # Snapshot back-compat: the "weights_dtype" key appears only
+        # once set (absent = "f32", the historical default).
+        self._weights_dtype: Optional[str] = None
+        self._weights_dtype_g = reg.gauge("serving/weights_dtype_code")
+
+    #: fixed value coding for serving/weights_dtype_code (absent
+    #: dtypes intentionally unrepresentable — bounded cardinality)
+    WEIGHTS_DTYPE_CODES = {"f32": 0, "bf16": 1, "int8": 2}
+
+    def set_weights_dtype(self, dtype: str) -> None:
+        """Tag the served model's weight dtype (``"f32"`` | ``"bf16"``
+        | ``"int8"``) — surfaces in :meth:`snapshot` and as the
+        pre-created ``serving/weights_dtype_code`` gauge on
+        ``/metrics``."""
+        if dtype not in self.WEIGHTS_DTYPE_CODES:
+            raise ValueError(
+                f"weights_dtype must be one of "
+                f"{sorted(self.WEIGHTS_DTYPE_CODES)}, got {dtype!r}")
+        self._weights_dtype = dtype
+        self._weights_dtype_g.set(self.WEIGHTS_DTYPE_CODES[dtype])
+
+    @property
+    def weights_dtype(self) -> Optional[str]:
+        return self._weights_dtype
 
     # back-compat value surface (pre-registry these were plain ints)
     @property
@@ -207,6 +235,8 @@ class ServingMetrics:
             "compile_count": compile_count,
             "uptime_s": round(uptime, 3),
         }
+        if self._weights_dtype is not None:
+            snap["weights_dtype"] = self._weights_dtype
         snap["latency_ms"] = self._ms(self._latency_h.percentiles())
         with self._lock:
             buckets = sorted(self._bucket_latency.items())

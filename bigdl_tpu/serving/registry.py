@@ -120,7 +120,7 @@ class ModelRegistry:
     # -- deployment --------------------------------------------------------
     def deploy(self, name: str, model=None, *, path: Optional[str] = None,
                format: Optional[str] = None, version: Optional[int] = None,
-               params=None, state=None, quantize: bool = False,
+               params=None, state=None, quantize=False,
                prototxt: Optional[str] = None,
                weights: Optional[str] = None,
                tf_inputs: Optional[List[str]] = None,
@@ -129,7 +129,15 @@ class ModelRegistry:
         """Deploy ``model`` (or load one from ``path``/``format``) as
         ``name``:``version``.  ``service_kw`` flows to
         :class:`InferenceService` (``input_spec`` for deploy-time AOT
-        warmup, batching/backpressure knobs, ``start=False``...)."""
+        warmup, batching/backpressure knobs, ``start=False``...).
+
+        ``quantize``: False (default) deploys as-is; True int8-quantizes
+        on the way in with the ``Config.int8_activation_mode`` default;
+        a mode string (``"weight_only"`` / ``"dynamic"``) pins the
+        activation mode.  The quantized deploy is a DISTINCT registry
+        version with its own circuit breaker and a ``weights_dtype``
+        stats tag — latest-wins routing plus the breaker gate rollback
+        to the float incumbent if the int8 version misbehaves."""
         if model is None:
             if path is None or format is None:
                 raise ValueError("deploy() needs model= or path=+format=")
@@ -138,7 +146,9 @@ class ModelRegistry:
                                 tf_outputs=tf_outputs)
         if quantize:
             from bigdl_tpu.nn.quantized import quantize as _quantize
-            model = _quantize(model)
+            # quantize=True -> config-default mode; a string pins it
+            q_mode = quantize if isinstance(quantize, str) else None
+            model = _quantize(model, mode=q_mode)
             params = state = None  # quantized twin re-owns its weights
         # reserve the (name, version) key BEFORE the (slow, lock-free)
         # AOT warmup in the service constructor: two concurrent deploys

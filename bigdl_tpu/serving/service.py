@@ -122,6 +122,35 @@ def pad_rows(x, target: int):
     return _tree.tree_map(pad, x)
 
 
+def _detect_weights_dtype(model, params) -> str:
+    """Classify the served model's weight storage: ``"int8"`` when any
+    quantized twin (``nn.quantized``) is in the module tree, else
+    ``"bf16"``/``"f32"`` from the param leaves.  Host-side, walked once
+    at service construction — the ``weights_dtype`` tag the int8
+    serving rollout gates on (stats()/``/metrics``)."""
+    from bigdl_tpu.nn.module import Container
+    from bigdl_tpu.nn.quantized import (QuantizedLinear,
+                                        QuantizedSpatialConvolution,
+                                        _QuantizedCellBase)
+    from bigdl_tpu.nn.recurrent import BiRecurrent, Recurrent
+    stack = [model]
+    while stack:
+        m = stack.pop()
+        if isinstance(m, (QuantizedLinear, QuantizedSpatialConvolution,
+                          _QuantizedCellBase)):
+            return "int8"
+        if isinstance(m, Container):
+            stack.extend(m.modules)
+        elif isinstance(m, Recurrent):
+            stack.append(m.cell)
+        elif isinstance(m, BiRecurrent):
+            stack.extend((m.fwd, m.bwd))
+    for leaf in jax.tree_util.tree_leaves(params):
+        if getattr(leaf, "dtype", None) == jnp.bfloat16:
+            return "bf16"
+    return "f32"
+
+
 class InferenceService:
     """Always-on inference endpoint for one model.
 
@@ -258,6 +287,12 @@ class InferenceService:
         self._lifecycle_lock = threading.Lock()
         self._stopped = False  # write-guarded-by: _lifecycle_lock
         self.metrics = ServingMetrics()
+        # weights-dtype tag (int8 speed-path PR): detected once here,
+        # surfaced in stats() and the pre-created /metrics gauge so the
+        # registry's per-version rollout gates can see WHAT dtype each
+        # deployed version serves (absent in old snapshots = "f32")
+        self.weights_dtype = _detect_weights_dtype(model, self.params)
+        self.metrics.set_weights_dtype(self.weights_dtype)
         # fault injection (resilience layer): the injector is consulted
         # per dispatch; _fault_replica is stamped by ReplicaSet so
         # target= clauses can aim at one replica of a set

@@ -106,6 +106,18 @@ class Config:
     # inherits kernel choice as one more measured knob.  Env:
     # BIGDL_TPU_KERNEL_IMPL.  Per-layer ``impl=`` constructor args win.
     kernel_impl: str = "auto"
+    # int8 quantized inference (nn/quantized.py over
+    # ops/pallas_int8_gemm.py).  int8_activation_mode is the default
+    # per-layer mode quantize(model) stamps on converted layers:
+    # "weight_only" (int8 weights, f32/bf16 activations, f32 MXU
+    # accumulation — no activation quantization error, the serving
+    # default) or "dynamic" (BigQuant-style on-the-fly int8
+    # activations, int32 accumulate).  int8_block_rows is the GEMM
+    # row-block size, 0 = auto (<=128 whole-batch, else 128-row
+    # blocks) — an autotuner knob like kernel_impl.  Env:
+    # BIGDL_TPU_INT8_ACTIVATION_MODE / BIGDL_TPU_INT8_BLOCK_ROWS.
+    int8_activation_mode: str = "weight_only"
+    int8_block_rows: int = 0
     # activation-memory policy default (Optimizer.set_activation_memory
     # overrides per run): "none" | "dots" | "full" | "bf16" |
     # "bf16+dots" | "bf16+full" — remat / bf16 activation storage for

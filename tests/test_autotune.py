@@ -167,6 +167,61 @@ class TestCheckedInFile:
 
 
 # ===========================================================================
+class TestInt8GemmWorkload:
+    """The quantized-GEMM tuning grid (the int8 speed-path PR)."""
+
+    def test_registered_with_gated_axes(self):
+        wl = autotune.WORKLOADS["int8_gemm"]
+        assert wl.kind == "kernel"
+        knobs = {ax.knob for ax in wl.axes}
+        assert knobs == {"int8_activation_mode", "kernel_impl",
+                         "int8_block_rows"}
+        # every knob must be a real Config field or configure() rejects
+        # the winning trial when it's merged back
+        cfg_fields = {f.name for f in
+                      __import__("dataclasses").fields(Config)}
+        assert knobs <= cfg_fields
+
+    def test_cpu_prunes_mosaic_knobs_loudly(self):
+        """On a non-TPU host only the activation-mode axis survives
+        (both modes are real XLA compute through the bitwise
+        fallback); the tile/impl knobs are pruned WITH reasons."""
+        wl = autotune.WORKLOADS["int8_gemm"]
+        kept, pruned = autotune.prune_axes(wl.axes, "cpu", 1)
+        assert [ax.knob for ax in kept] == ["int8_activation_mode"]
+        assert set(pruned) == {"kernel_impl", "int8_block_rows"}
+        for why in pruned.values():
+            assert why  # never silently
+
+    def test_smoke_grid_measures_on_cpu(self):
+        r = autotune.tune("int8_gemm", budget=6, smoke=True,
+                          dry_run=True)
+        assert r["n_configs"] == 2  # weight_only vs dynamic
+        assert r["best_config"]["int8_activation_mode"] in (
+            "weight_only", "dynamic")
+        assert r["score"] > 0
+
+    def test_tuned_block_rows_picked_up_by_kernel_chain(
+            self, monkeypatch, tmp_path):
+        """int8_matmul's block_rows=None defers to the config chain:
+        a tuned int8_gemm@cpu entry must win over the dataclass
+        default, and an explicit configure() must beat the tuned
+        value."""
+        p = write_doc(tmp_path / "t.json", {"int8_gemm@cpu": make_entry(
+            workload="int8_gemm", best={"int8_block_rows": 64})})
+        use_file(monkeypatch, p)
+        v, src = tuned.resolve_default("int8_block_rows",
+                                       workload="int8_gemm",
+                                       backend="cpu")
+        assert (v, src) == (64, "tuned")
+        configure(int8_block_rows=128)
+        v, src = tuned.resolve_default("int8_block_rows",
+                                       workload="int8_gemm",
+                                       backend="cpu")
+        assert (v, src) == (128, "explicit")
+
+
+# ===========================================================================
 class TestResolutionChain:
     """explicit setter > BIGDL_TPU_* env > tuned entry > dataclass
     default, per knob (the documented order, utils/tuned docstring)."""

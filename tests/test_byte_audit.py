@@ -174,6 +174,27 @@ class TestDiffAudit:
         # least the two multiply operands' worth
         assert d["total_a"] - d["total_b"] > 2 * 65536 * 16 * F32
 
+    def test_int8_gemm_weight_panel_strictly_lower(self):
+        """The int8 speed-path acceptance bar: the quantized GEMM's
+        step program moves strictly fewer bytes than the f32 linear,
+        and the saving is dominated by the weight panel (s8[256,256]
+        = 64 KiB vs f32[256,256] = 256 KiB; the f32 scale/bias rows it
+        adds are 2 KiB)."""
+        d = diff_audit(_load("hlo_int8_gemm_f32.txt"),
+                       _load("hlo_int8_gemm_pallas.txt"))
+        assert d["total_b"] < d["total_a"]  # strictly lower, the gate
+        panel_f32 = 256 * 256 * F32
+        panel_s8 = 256 * 256
+        extra_rows = 2 * 256 * F32  # (1,256) scale + (1,256) bias
+        # the f32 baseline also pays the broadcast bias materialization
+        # the fused epilogue removes; the panel saving alone must be
+        # visible net of the added scale/bias reads
+        assert d["total_a"] - d["total_b"] >= \
+            (panel_f32 - panel_s8) - extra_rows
+        per = {k: (a, b) for k, a, b, _ in d["per_op"]}
+        assert per["dot"][1] == 0 and per["broadcast"][1] == 0
+        assert per["custom-call"][0] == 0 and per["custom-call"][1] > 0
+
 
 class TestCopyAudit:
     """--audit-copies (round-10 donation/aliasing audit)."""

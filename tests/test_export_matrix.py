@@ -215,6 +215,83 @@ class TestConvertModelCLI:
         np.testing.assert_allclose(np.asarray(m2.forward(x)), want,
                                    rtol=1e-5)
 
+    def test_quantize_round_trip(self, tmp_path):
+        """--quantize through the kernel-backed int8 GEMM path: the
+        128-multiple dims make the panel eligible for the pallas
+        kernel; the saved model reloads as quantized twins with a
+        byte-exact int8 panel (values -127..127 are lossless through
+        the f32 tensor wire format), so the loaded forward is bitwise
+        the in-memory quantized forward."""
+        import jax.numpy as jnp
+
+        from bigdl_tpu.interop import save_bigdl_module, load_bigdl_module
+        from bigdl_tpu.interop.convert_model import main
+        from bigdl_tpu.nn.quantized import QuantizedLinear, quantize
+        m = nn.Sequential(nn.Linear(128, 128, name="fc1"), nn.ReLU(),
+                          nn.Linear(128, 2, name="fc2"), name="QMLP")
+        m.initialize(7)
+        m.evaluate()
+        x = np.random.RandomState(3).rand(4, 128).astype(np.float32)
+        want = np.asarray(m.forward(x))
+        src = str(tmp_path / "m.bigdl")
+        dst = str(tmp_path / "q.bigdl")
+        save_bigdl_module(m, src)
+        main(["--from", "bigdl", "--input", src, "--to", "bigdl",
+              "--output", dst, "--quantize"])
+        q = load_bigdl_module(dst)
+        q.evaluate()
+        got = np.asarray(q.forward(x))
+        # int8 weight error bound (the CLI's own parity gate already
+        # enforced 0.05 before saving)
+        assert np.max(np.abs(got - want)) / np.max(np.abs(want)) < 0.05
+        assert isinstance(q.modules[0], QuantizedLinear)
+        assert q.modules[0].weight_q.dtype == jnp.int8
+        assert q.modules[0].mode == "weight_only"
+        qm = quantize(m)
+        qm.evaluate()
+        np.testing.assert_array_equal(np.asarray(qm.forward(x)), got)
+
+    def test_quantize_conv_round_trip_dynamic(self, tmp_path):
+        from bigdl_tpu.interop import save_bigdl_module, load_bigdl_module
+        from bigdl_tpu.interop.convert_model import main
+        from bigdl_tpu.nn.quantized import QuantizedSpatialConvolution
+        c = nn.Sequential(
+            nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1,
+                                  name="c1"),
+            nn.ReLU(), name="QCNN")
+        c.initialize(5)
+        c.evaluate()
+        x = np.random.RandomState(4).rand(2, 3, 8, 8).astype(np.float32)
+        want = np.asarray(c.forward(x))
+        src = str(tmp_path / "c.bigdl")
+        dst = str(tmp_path / "qc.bigdl")
+        save_bigdl_module(c, src)
+        main(["--from", "bigdl", "--input", src, "--to", "bigdl",
+              "--output", dst, "--quantize", "--quantize-mode",
+              "dynamic"])
+        qc = load_bigdl_module(dst)
+        qc.evaluate()
+        got = np.asarray(qc.forward(x))
+        assert np.max(np.abs(got - want)) / np.max(np.abs(want)) < 0.05
+        assert isinstance(qc.modules[0], QuantizedSpatialConvolution)
+        assert qc.modules[0].mode == "dynamic"  # mode survives the file
+
+    def test_quantize_parity_gate_aborts_before_save(self, tmp_path):
+        """The forward-parity check refuses to write the output when
+        the quantized model misses the tolerance (any model has
+        nonzero int8 error, so a near-zero tolerance must trip it)."""
+        from bigdl_tpu.interop import save_bigdl_module
+        from bigdl_tpu.interop.convert_model import main
+        m = self._mlp()
+        src = str(tmp_path / "m.bigdl")
+        dst = str(tmp_path / "q.bigdl")
+        save_bigdl_module(m, src)
+        with pytest.raises(SystemExit, match="parity check FAILED"):
+            main(["--from", "bigdl", "--input", src, "--to", "bigdl",
+                  "--output", dst, "--quantize",
+                  "--quantize-tolerance", "1e-9"])
+        assert not os.path.exists(dst)  # nothing was saved
+
     def test_bigdl_to_caffe(self, tmp_path):
         from bigdl_tpu.interop import save_bigdl_module, load_caffe_model
         from bigdl_tpu.interop.convert_model import main

@@ -2615,7 +2615,24 @@ class TestRealTree:
         kernel gate leaked into traced scope (see the catalog note
         "kernel gating is host code")."""
         result = lint_paths([os.path.join(REPO, "bigdl_tpu", "ops")])
-        assert result.files_scanned >= 4
+        assert result.files_scanned >= 5  # incl. pallas_int8_gemm.py
+        msgs = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], msgs
+
+    def test_int8_gemm_modules_lint_clean(self):
+        """Standalone gate for the int8 speed path (the quantized
+        inference PR): the GEMM wrapper's mode/impl/supported() gating
+        and the quantized layers' GEMM-engagement checks
+        (``_gemm_engages``) are host code by the same contract as every
+        kernel gate — static shape/dtype/config facts only (catalog
+        note "int8 kernel gating is host code").  A violation here
+        means quantization dispatch grew a tensor-valued branch or a
+        traced-scope sync."""
+        result = lint_paths([
+            os.path.join(REPO, "bigdl_tpu", "ops",
+                         "pallas_int8_gemm.py"),
+            os.path.join(REPO, "bigdl_tpu", "nn", "quantized.py")])
+        assert result.files_scanned == 2
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 

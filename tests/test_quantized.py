@@ -144,3 +144,60 @@ def test_quantized_bi_recurrent():
     out = jax.jit(lambda xx: q.apply(q._params, q._state, xx,
                                      training=False)[0])(x)
     assert np.max(np.abs(np.asarray(out) - ref)) < 0.08
+
+
+# --------------------------------------------- activation modes (this PR)
+# bounds per mode: dynamic adds per-tensor activation rounding on top of
+# the weight rounding, so its band is wider; saturating gate activations
+# keep the recurrent dynamics close either way
+_RECURRENT_MODE_TOL = {"weight_only": 0.06, "dynamic": 0.10}
+
+
+@pytest.mark.parametrize("mode", ["weight_only", "dynamic"])
+def test_recurrent_parity_both_modes(mode):
+    from bigdl_tpu.nn.quantized import quantize
+    from bigdl_tpu.nn.recurrent import GRU, LSTM, Recurrent
+    rng = np.random.RandomState(4)
+    for cell_fn in (lambda: LSTM(6, 8), lambda: GRU(6, 8)):
+        model = nn.Sequential(Recurrent(cell_fn()))
+        model.initialize(0)
+        x = jnp.asarray(rng.rand(3, 7, 6).astype(np.float32))
+        ref = np.asarray(model.forward(x))
+        q = quantize(model, mode=mode)
+        assert q.modules[0].cell.mode == mode
+        err = np.max(np.abs(np.asarray(q.forward(x)) - ref))
+        assert err < _RECURRENT_MODE_TOL[mode], (mode, err)
+
+
+def test_quantize_stamps_mode_on_every_leaf():
+    from bigdl_tpu.nn.quantized import quantize
+    from bigdl_tpu.nn.recurrent import LSTM, Recurrent
+    model = nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(),
+        nn.SpatialConvolution(1, 2, 3, 3),
+        Recurrent(LSTM(4, 4)))
+    model.initialize(1)
+    q = quantize(model, mode="dynamic")
+    stamped = [m.mode for m in (q.modules[0], q.modules[2],
+                                q.modules[3].cell)]
+    assert stamped == ["dynamic"] * 3
+
+
+def test_quantize_is_idempotent():
+    """A second quantize() pass must keep already-quantized leaves
+    as-is — same objects' buffers, bitwise-identical forward — instead
+    of re-quantizing the int8 grid (which would compound rounding)."""
+    from bigdl_tpu.nn.quantized import quantize
+    rng = np.random.RandomState(5)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                          nn.Linear(32, 4))
+    model.initialize(0)
+    model.training = False
+    x = rng.randn(4, 16).astype(np.float32)
+    q1 = quantize(model)
+    y1 = np.asarray(q1.forward(x))
+    q2 = quantize(q1)
+    assert isinstance(q2.modules[0], QuantizedLinear)
+    np.testing.assert_array_equal(
+        np.asarray(q2.modules[0].weight_q), np.asarray(q1.modules[0].weight_q))
+    np.testing.assert_array_equal(np.asarray(q2.forward(x)), y1)

@@ -416,6 +416,52 @@ def _serving_runner(smoke: bool) -> Callable:
     return measure
 
 
+def _int8_gemm_runner(smoke: bool) -> Callable:
+    """Quantized-GEMM trials: raw ``ops.pallas_int8_gemm.int8_matmul``
+    throughput on a serving-shaped panel (small batch, square
+    128-multiple K/O so the kernel's ``supported()`` gate passes).
+    The activation-mode knob is measurable on any backend — both modes
+    lower to real XLA compute through the bitwise fallback (f32 MXU
+    dot vs int8 quantize + int32 dot); the tile/impl knobs only change
+    Mosaic behaviour and are tpu-gated below."""
+    import jax
+    import numpy as np
+
+    from bigdl_tpu.ops.pallas_int8_gemm import int8_matmul
+
+    if smoke:
+        batch, k, o, iters = 8, 128, 128, 4
+    else:
+        batch, k, o, iters = 32, 512, 512, 50
+    rng = np.random.default_rng(0)  # same data every trial
+    x = np.asarray(rng.normal(0, 1, (batch, k)), np.float32)
+    wq = rng.integers(-127, 128, (o, k)).astype(np.int8)
+    ws = (rng.uniform(0.001, 0.02, (o, 1))).astype(np.float32)
+    b = rng.normal(0, 1, (o,)).astype(np.float32)
+
+    def measure(trial, windows, rung):
+        mode = trial.get("int8_activation_mode", "weight_only")
+        impl = trial.get("kernel_impl")
+        block_rows = trial.get("int8_block_rows")
+
+        @jax.jit
+        def step(xin):
+            return int8_matmul(xin, wq, ws, b, mode=mode, impl=impl,
+                               block_rows=block_rows)
+
+        step(x).block_until_ready()  # compile outside the window
+        samples = []
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                y = step(x)
+            y.block_until_ready()
+            samples.append(iters * batch / (time.perf_counter() - t0))
+        return samples
+
+    return measure
+
+
 # ----------------------------------------------------------- registry
 _TRAINING_AXES = (
     Axis("steps_per_dispatch", (1, 2, 4, 8, 16)),
@@ -448,6 +494,24 @@ _SERVING_SMOKE_AXES = (
     Axis("serving_row_buckets", ("pow2",)),
 )
 
+_INT8_GEMM_AXES = (
+    # measurable anywhere: both modes are real XLA compute through the
+    # bitwise fallback (weight_only = f32 MXU dot against the int8
+    # panel; dynamic = on-the-fly activation quantization + int32 dot)
+    Axis("int8_activation_mode", ("weight_only", "dynamic")),
+    Axis("kernel_impl", ("xla", "pallas"), requires="tpu",
+         why="interpret-mode pallas on a non-TPU host is correctness "
+             "emulation, not a perf signal (ops/PALLAS_NOTES.md); the "
+             "knob keeps its config-chain default"),
+    Axis("int8_block_rows", (0, 64, 128, 256), requires="tpu",
+         why="the row-block tile only exists inside the Mosaic kernel; "
+             "interpret-mode tiling on a non-TPU host times the "
+             "emulator, not the MXU"),
+)
+_INT8_GEMM_SMOKE_AXES = (
+    Axis("int8_activation_mode", ("weight_only", "dynamic")),
+)
+
 WORKLOADS: Dict[str, Workload] = {
     "ptb_lstm": Workload("ptb_lstm", "training", _TRAINING_AXES,
                          _TRAINING_SMOKE_AXES, _ptb_runner),
@@ -455,6 +519,8 @@ WORKLOADS: Dict[str, Workload] = {
                           _TRAINING_SMOKE_AXES, _wide_deep_runner),
     "serving_mlp": Workload("serving_mlp", "serving", _SERVING_AXES,
                             _SERVING_SMOKE_AXES, _serving_runner),
+    "int8_gemm": Workload("int8_gemm", "kernel", _INT8_GEMM_AXES,
+                          _INT8_GEMM_SMOKE_AXES, _int8_gemm_runner),
 }
 
 
