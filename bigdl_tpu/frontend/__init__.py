@@ -1,11 +1,14 @@
 """bigdl_tpu.frontend — the wire-level serving front end.
 
 The network face of the serving plane (ROADMAP item 1, the Cluster-
-Serving shape of BigDL 2.0, arXiv:2204.01715): a stdlib-only threaded
+Serving shape of BigDL 2.0, arXiv:2204.01715): a stdlib-only
 HTTP/1.1 server over the existing :class:`~bigdl_tpu.serving.
-ModelRegistry` / :class:`~bigdl_tpu.resilience.ReplicaSet` engines,
-plus the three service-platform behaviors large-scale serving treats
-as table stakes:
+ModelRegistry` / :class:`~bigdl_tpu.resilience.ReplicaSet` engines —
+connections owned by a selectors-based event loop by default
+(``frontend/eventloop.py`` + the ``frontend/http1.py`` incremental
+parser, ROADMAP item 2; ``core="threaded"`` keeps the original
+thread-per-connection core) — plus the three service-platform
+behaviors large-scale serving treats as table stakes:
 
 - :class:`FrontendServer` — ``POST /v1/models/<name>[:<v>]/predict``
   with JSON / raw-npy bodies, chunked ndjson streaming for multi-chunk

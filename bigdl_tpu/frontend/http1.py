@@ -1,0 +1,208 @@
+"""Incremental HTTP/1.1 request framing for the event-loop front end.
+
+The parser half of the C100K wire plane (ROADMAP item 2): a pure,
+allocation-light state machine the loop core feeds raw socket bytes —
+no file objects, no blocking reads, no threads.  ``feed()`` only
+appends; ``head()`` / ``poll()`` advance the machine and either return
+parsed structures, return ``None`` (need more bytes — the slow-loris
+case: a byte-dribbled request line parks the CONNECTION, never a
+thread or a loop tick), or raise :class:`ProtocolError` carrying the
+HTTP status the connection should die with.  Body framing is
+Content-Length only — the same surface the threaded core speaks
+(chunked REQUEST bodies were never accepted there either; the value is
+validated and refused at the exchange layer so the 400/411/413 error
+taxonomy matches the threaded core byte for byte).
+
+Keep-alive semantics follow the RFC defaults the stdlib handler uses:
+HTTP/1.1 persists unless ``Connection: close``; HTTP/1.0 closes unless
+``Connection: keep-alive``.  After ``poll()`` returns a complete
+request the parser is immediately ready for the next one on the same
+buffer, so pipelined bytes are never mis-framed (the keep-alive desync
+guard, now at the parser layer).
+
+Separated from the loop so the robustness tests can drive it
+byte-at-a-time without sockets (``tests/test_frontend_eventloop.py``).
+"""
+
+from __future__ import annotations
+
+from http.client import responses as _REASONS
+from typing import Dict, Optional
+
+# caps: a request head (line + headers) past this size is a client
+# error (431), not a reason to buffer unboundedly — the slow-loris
+# memory bound for the head phase
+MAX_HEAD_BYTES = 64 << 10
+
+
+class ProtocolError(Exception):
+    """Unrecoverable wire-level framing error: respond ``status`` (if
+    anything can still be written) and close — re-synchronizing a
+    stream after a malformed head is guesswork."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class Request:
+    """One parsed request.  ``headers`` keys are lowercased; ``body``
+    is filled by ``poll()`` (empty until then)."""
+
+    __slots__ = ("method", "target", "version", "headers", "keep_alive",
+                 "body")
+
+    def __init__(self, method: str, target: str, version: str,
+                 headers: Dict[str, str], keep_alive: bool):
+        self.method = method
+        self.target = target
+        self.version = version
+        self.headers = headers
+        self.keep_alive = keep_alive
+        self.body = b""
+
+    def get(self, name: str, default=None):
+        return self.headers.get(name.lower(), default)
+
+
+def _body_length(headers: Dict[str, str]) -> int:
+    """Framing length from Content-Length.  Missing / unparseable /
+    negative values frame as ZERO body — the exchange layer then
+    answers the threaded core's exact 411/400 and closes, so the bogus
+    framing never reaches a next request."""
+    cl = headers.get("content-length")
+    if cl is None:
+        return 0
+    try:
+        n = int(cl.strip())
+    except ValueError:
+        return 0
+    return n if n > 0 else 0
+
+
+class RequestParser:
+    """Incremental request parser: ``feed(bytes)`` → ``head()`` /
+    ``poll()``.  Once a :class:`ProtocolError` is raised the parser is
+    poisoned (every later call re-raises): the connection is done."""
+
+    def __init__(self, max_head: int = MAX_HEAD_BYTES):
+        self._max_head = int(max_head)
+        self._buf = bytearray()
+        self._head: Optional[Request] = None
+        self._body_len = 0
+        self._error: Optional[ProtocolError] = None
+
+    def feed(self, data: bytes) -> None:
+        """Append raw socket bytes.  Never raises — errors surface
+        from ``head()``/``poll()`` so the reader's fast path stays
+        branch-free."""
+        if self._error is None and data:
+            self._buf += data
+
+    def buffered(self) -> int:
+        return len(self._buf)
+
+    def head(self) -> Optional[Request]:
+        """The current request's head once its header block is
+        complete (body may still be arriving), else ``None``.  Lets
+        the exchange layer run must-happen-before-body checks (auth,
+        411/413) without waiting for — or ever reading — the body."""
+        if self._error is not None:
+            raise self._error
+        if self._head is None:
+            self._parse_head()
+        return self._head
+
+    def poll(self) -> Optional[Request]:
+        """A COMPLETE request (head + Content-Length body) or
+        ``None``; returning one resets the machine for the next
+        request on the same connection."""
+        req = self.head()
+        if req is None or len(self._buf) < self._body_len:
+            return None
+        req.body = bytes(self._buf[:self._body_len])
+        del self._buf[:self._body_len]
+        self._head = None
+        self._body_len = 0
+        return req
+
+    # -- internals ---------------------------------------------------------
+    def _fail(self, status: int, message: str):
+        self._error = ProtocolError(status, message)
+        self._buf.clear()
+        raise self._error
+
+    def _parse_head(self) -> None:
+        # tolerate a stray CRLF preamble between keep-alive requests
+        # (RFC 9112 §2.2) — some clients flush one after a body
+        while self._buf[:2] == b"\r\n":
+            del self._buf[:2]
+        end = self._buf.find(b"\r\n\r\n")
+        if end < 0:
+            if len(self._buf) > self._max_head:
+                self._fail(431, f"request head exceeds the "
+                                f"{self._max_head} byte cap")
+            return
+        if end > self._max_head:
+            self._fail(431, f"request head exceeds the "
+                            f"{self._max_head} byte cap")
+        block = bytes(self._buf[:end])
+        del self._buf[:end + 4]
+        lines = block.decode("latin-1").split("\r\n")
+        parts = lines[0].split()
+        if len(parts) != 3:
+            self._fail(400, f"malformed request line {lines[0]!r}")
+        method, target, version = parts
+        if version not in ("HTTP/1.0", "HTTP/1.1"):
+            self._fail(505, f"unsupported protocol version {version!r}")
+        headers: Dict[str, str] = {}
+        last: Optional[str] = None
+        for ln in lines[1:]:
+            if ln[:1] in (" ", "\t") and last is not None:
+                # obs-fold continuation: join with a space (RFC 9112)
+                headers[last] += " " + ln.strip()
+                continue
+            name, sep, value = ln.partition(":")
+            if not sep or not name or name.strip() != name:
+                # whitespace before the colon is a smuggling classic —
+                # refuse rather than guess (matches RFC 9112 §5.1 MUST)
+                self._fail(400, f"malformed header line {ln!r}")
+            last = name.lower()
+            headers[last] = value.strip()
+        conn_toks = headers.get("connection", "").lower()
+        keep_alive = ("close" not in conn_toks if version == "HTTP/1.1"
+                      else "keep-alive" in conn_toks)
+        self._head = Request(method, target, version, headers,
+                             keep_alive)
+        self._body_len = _body_length(headers)
+
+
+# -- response encoding (the write half of the wire) ------------------------
+def render_head(status: int, headers=None, *,
+                content_length: Optional[int] = None,
+                chunked: bool = False, close: bool = False) -> bytes:
+    """Serialize one response head.  Exactly one framing mode: chunked
+    OR Content-Length (every non-chunked response MUST carry one —
+    keep-alive clients frame the next response off it)."""
+    reason = _REASONS.get(status, "")
+    lines = [f"HTTP/1.1 {status} {reason}".rstrip()]
+    for k, v in (headers or {}).items():
+        lines.append(f"{k}: {v}")
+    if chunked:
+        lines.append("Transfer-Encoding: chunked")
+    elif content_length is not None:
+        lines.append(f"Content-Length: {content_length}")
+    if close:
+        lines.append("Connection: close")
+    return ("\r\n".join(lines) + "\r\n\r\n").encode("latin-1")
+
+
+def encode_chunk(data: bytes) -> bytes:
+    """One chunked-transfer frame (empty payloads encode to nothing —
+    a zero-length chunk would terminate the stream)."""
+    if not data:
+        return b""
+    return f"{len(data):x}\r\n".encode("latin-1") + data + b"\r\n"
+
+
+CHUNK_TRAILER = b"0\r\n\r\n"

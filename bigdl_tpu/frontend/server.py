@@ -135,6 +135,55 @@ class _WireInflight:
             return True
 
 
+class _ConnLedger:
+    """Open-connection accounting + the hard cap, shared by BOTH
+    connection cores (threaded and event-loop): ``try_admit`` is the
+    one cheap gate every fresh accept passes, ``release`` the one exit.
+    Mirrors into the server's MetricRegistry (``frontend/
+    open_connections`` gauge + accepted/closed/reaped/refused
+    counters) so a zero-traffic scrape already shows the schema."""
+
+    def __init__(self, metrics: MetricRegistry, max_connections: int):
+        self._lock = threading.Lock()
+        self._open = 0  # guarded-by: _lock
+        self.max_connections = max(0, int(max_connections))  # 0 = uncapped
+        self._gauge = metrics.gauge("frontend/open_connections")
+        self._accepted = metrics.counter("frontend/conns_accepted")
+        self._closed = metrics.counter("frontend/conns_closed")
+        self._reaped = metrics.counter("frontend/conns_reaped")
+        self._refused = metrics.counter("frontend/conns_refused")
+
+    def try_admit(self) -> bool:
+        """One accept's verdict.  False → the caller just closes the
+        socket (counted refused) — no parser, no thread, no state."""
+        with self._lock:
+            if self.max_connections \
+                    and self._open >= self.max_connections:
+                admitted = False
+            else:
+                self._open += 1
+                self._gauge.set(self._open)
+                admitted = True
+        if admitted:
+            self._accepted.inc()
+        else:
+            self._refused.inc()
+        return admitted
+
+    def release(self, reaped: bool = False) -> None:
+        with self._lock:
+            self._open = max(0, self._open - 1)
+            self._gauge.set(self._open)
+        self._closed.inc()
+        if reaped:
+            self._reaped.inc()
+
+    @property
+    def open(self) -> int:
+        with self._lock:
+            return self._open
+
+
 class _HTTPError(Exception):
     """Internal: carries an HTTP status + JSON body to the handler."""
 
@@ -225,7 +274,12 @@ class FrontendServer:
                  port: Optional[int] = 0, host: str = "127.0.0.1",
                  tracer=None, name: str = "frontend",
                  stream_window: int = 4,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 core: Optional[str] = None,
+                 shards: Optional[int] = None,
+                 max_connections: Optional[int] = None,
+                 idle_timeout_s: Optional[float] = None,
+                 reuse_port: bool = False):
         if port is None:
             from bigdl_tpu.utils.config import get_config
             port = int(getattr(get_config(), "frontend_port", 0) or 0)
@@ -274,17 +328,42 @@ class FrontendServer:
                 "bearer-token auth; X-Tenant remains a QoS tag, not a "
                 "credential", host)
         self._stream_window = max(1, int(stream_window))
+        # connection-core knobs (ROADMAP item 2): unset values resolve
+        # Config — env-tunable without touching call sites
+        from bigdl_tpu.utils.config import get_config
+        _cfg = get_config()
+        if core is None:
+            core = getattr(_cfg, "frontend_core", "eventloop") \
+                or "eventloop"
+        if core not in ("eventloop", "threaded"):
+            raise ValueError(f"unknown frontend core {core!r} — "
+                             f"expected 'eventloop' or 'threaded'")
+        self.core = core
+        if shards is None:
+            shards = int(getattr(_cfg, "frontend_shards", 1) or 1)
+        self._shards = max(1, int(shards))
+        if max_connections is None:
+            max_connections = int(getattr(
+                _cfg, "frontend_max_connections", 0) or 0)
+        if idle_timeout_s is None:
+            idle_timeout_s = float(getattr(
+                _cfg, "frontend_idle_timeout_s", 0.0) or 0.0)
+        self._idle_timeout_s = max(0.0, float(idle_timeout_s))
+        self._reuse_port = bool(reuse_port)
         self._lock = threading.Lock()
         self._backends: Dict[str, object] = dict(backends or {})  # guarded-by: _lock
         self.inflight = _WireInflight()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._elc = None  # EventLoopCore when core="eventloop" is live
         # counters pre-created so a zero-traffic scrape shows the schema
         for c in ("requests", "responses_2xx", "responses_4xx",
                   "responses_5xx", "sheds", "deadline_504",
                   "stream_chunks", "client_disconnects"):
             self.metrics.counter(f"frontend/{c}")
         self._latency_h = self.metrics.histogram("frontend/wire_latency_s")
+        # connection-plane schema (gauge + counters) pre-created too
+        self._conns = _ConnLedger(self.metrics, max_connections)
         # admin plane: the wire+tenant registry and the tracer scrape
         # from the same endpoint as everything else
         from bigdl_tpu.telemetry import admin as _admin
@@ -432,17 +511,10 @@ class FrontendServer:
                 "wire deadline expired while the request was "
                 "queued") from None
 
-    def _run_predict(self, handler, name, version, body, ctype,
-                     accept, tenant, deadline_ms, trace_id) -> None:
-        """The whole exchange for one POST .../predict."""
-        t0 = time.monotonic()
-        self.metrics.counter("frontend/requests").inc()
-        self.qos.admit(tenant)  # raises 429/403 before any queue touch
-        deadline = (t0 + float(deadline_ms) / 1e3
-                    if deadline_ms is not None else None)
-        ctx = RequestContext(trace_id=trace_id, tenant=tenant,
-                             deadline=deadline)
-        key, backend, brk = self._resolve(name, version)
+    @staticmethod
+    def _parse_body(body: bytes, ctype: str):
+        """Request body → ``(input_pytree, rows)`` — the one 400
+        taxonomy both connection cores share."""
         if ctype == _NPY:
             try:
                 x = np.load(BytesIO(body), allow_pickle=False)
@@ -479,6 +551,20 @@ class FrontendServer:
         except (AttributeError, IndexError):
             raise _HTTPError(400, "inputs must have a leading batch "
                                   "dim") from None
+        return x, rows
+
+    def _run_predict(self, handler, name, version, body, ctype,
+                     accept, tenant, deadline_ms, trace_id) -> None:
+        """The whole exchange for one POST .../predict."""
+        t0 = time.monotonic()
+        self.metrics.counter("frontend/requests").inc()
+        self.qos.admit(tenant)  # raises 429/403 before any queue touch
+        deadline = (t0 + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        ctx = RequestContext(trace_id=trace_id, tenant=tenant,
+                             deadline=deadline)
+        key, backend, brk = self._resolve(name, version)
+        x, rows = self._parse_body(body, ctype)
         ok = False
         try:
             for attempt in range(3):
@@ -703,10 +789,30 @@ class FrontendServer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> int:
-        """Bind + serve on daemon threads; idempotent.  Returns the
-        bound port."""
-        if self._httpd is not None:
+        """Bind + serve; idempotent.  Returns the bound port.  The
+        ``core`` knob picks the connection core: ``"eventloop"`` (the
+        default — a few selector loop threads own every socket,
+        optionally SO_REUSEPORT-sharded) or ``"threaded"`` (the PR-14
+        thread-per-connection stdlib core).  Both speak the identical
+        wire surface."""
+        if self._httpd is not None or self._elc is not None:
             return self.port
+        if self.core == "eventloop":
+            from bigdl_tpu.frontend.eventloop import EventLoopCore
+            self._elc = EventLoopCore(
+                self, host=self.host, port=self.requested_port,
+                shards=self._shards, reuse_port=self._reuse_port,
+                idle_timeout_s=self._idle_timeout_s)
+            self.port = self._elc.start()
+            logger.info(
+                "wire frontend listening on http://%s:%d "
+                "(event-loop core, %d shard(s); POST "
+                "/v1/models/<name>/predict)", self.host, self.port,
+                self._shards)
+            return self.port
+        return self._start_threaded()
+
+    def _start_threaded(self) -> int:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -721,9 +827,21 @@ class FrontendServer:
             # bench's wire_overhead_ms before this pair of lines
             wbufsize = 64 * 1024
             disable_nagle_algorithm = True
+            # idle keep-alive connections die after this many seconds
+            # (the threaded twin of the event-loop core's reaper; None
+            # keeps the historical wait-forever behavior)
+            timeout = server._idle_timeout_s or None
 
             def log_message(self, fmt, *args):
                 logger.debug("frontend: " + fmt, *args)
+
+            def finish(self):
+                try:
+                    super().finish()
+                finally:
+                    # admitted in verify_request; released exactly once
+                    # per connection, however the handler exits
+                    server._conns.release()
 
             # -- response primitives the server methods drive ----------
             def send_body(self, status, body: bytes, ctype: str,
@@ -873,9 +991,22 @@ class FrontendServer:
                     except ConnectionError:
                         pass
 
-        self._httpd = ThreadingHTTPServer(
+        class _Httpd(ThreadingHTTPServer):
+            daemon_threads = True
+            # socketserver's default backlog of 5 SYN-drops any
+            # connect burst; keep the threaded baseline comparable in
+            # the bench connection sweep
+            request_queue_size = 1024
+
+            def verify_request(self, request, client_address):
+                # the hard connection cap, enforced BEFORE a handler
+                # thread is spawned — socketserver closes the refused
+                # socket itself (the cheap-refusal contract both cores
+                # share)
+                return server._conns.try_admit()
+
+        self._httpd = _Httpd(
             (self.host, self.requested_port), Handler)
-        self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
@@ -920,12 +1051,23 @@ class FrontendServer:
 
     @property
     def running(self) -> bool:
+        if self._elc is not None:
+            return self._elc.running
         return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def open_connections(self) -> int:
+        """Live connection count (same number the
+        ``frontend/open_connections`` gauge exports)."""
+        return self._conns.open
 
     def url(self, path: str = "/") -> str:
         return f"http://{self.host}:{self.port}{path}"
 
     def stop(self) -> None:
+        elc, self._elc = self._elc, None
+        if elc is not None:
+            elc.stop()
         httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.shutdown()

@@ -191,6 +191,31 @@ class Config:
     # credential).  "" (default) keeps the historical loopback-open
     # behavior.  Env: BIGDL_TPU_FRONTEND_AUTH_TOKEN.
     frontend_auth_token: str = ""
+    # wire-frontend connection core (frontend/server.py +
+    # frontend/eventloop.py): "eventloop" (default) serves every
+    # connection from a small set of selector loop threads with
+    # incremental HTTP/1.1 parsing and callback-driven writes — no
+    # thread per connection; "threaded" keeps the PR-14
+    # thread-per-connection stdlib core.  Both speak the identical
+    # wire surface (one shared test suite).  Env: BIGDL_TPU_FRONTEND_CORE.
+    frontend_core: str = "eventloop"
+    # event-loop shard count: number of loop threads, each binding its
+    # own SO_REUSEPORT listener on the same port so the kernel spreads
+    # accepts (multi-core fan-in).  Platforms without SO_REUSEPORT fall
+    # back to one shared listener round-robined across the loops.
+    # Env: BIGDL_TPU_FRONTEND_SHARDS.
+    frontend_shards: int = 1
+    # hard cap on concurrently-open wire connections (both cores):
+    # past it, fresh accepts are refused with a bare close before any
+    # parser/thread exists — counted frontend/conns_refused.  0 =
+    # uncapped.  Env: BIGDL_TPU_FRONTEND_MAX_CONNECTIONS.
+    frontend_max_connections: int = 10000
+    # idle keep-alive reap timeout (seconds): connections with no
+    # in-flight exchange and no traffic for this long are closed
+    # (frontend/conns_reaped), so idle floods cannot starve active
+    # clients of fds.  0 = never reap.  Env:
+    # BIGDL_TPU_FRONTEND_IDLE_TIMEOUT_S.
+    frontend_idle_timeout_s: float = 120.0
     # lockdep (utils/lockdep.py): TSan-lite lock-order sanitizer for
     # the threaded host plane.  False (default) = provably inert — no
     # wrapper object is ever allocated, threading.Lock/RLock stay the

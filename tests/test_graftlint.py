@@ -2674,10 +2674,13 @@ class TestRealTree:
         the package), and the new threaded modules carry
         `# guarded-by:` annotations from day one.  GL1xx and GL2xx
         both run here; a violation means the wire plane grew either a
-        traced-scope hazard or an unguarded-shared-state regression."""
+        traced-scope hazard or an unguarded-shared-state regression.
+        ISSUE-19 adds the event-loop core (eventloop.py, http1.py):
+        loop-owned state rides the documented single-owner discipline,
+        cross-thread handoffs stay lock-guarded."""
         result = lint_paths([os.path.join(REPO, "bigdl_tpu",
                                           "frontend")])
-        assert result.files_scanned == 5
+        assert result.files_scanned == 7
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 
@@ -2689,7 +2692,7 @@ class TestRealTree:
         result = lint_paths([os.path.join(REPO, "bigdl_tpu",
                                           "frontend")],
                             select=["GL2"])
-        assert result.files_scanned == 5
+        assert result.files_scanned == 7
         msgs = "\n".join(v.render() for v in result.violations)
         assert result.violations == [], msgs
 

@@ -468,6 +468,38 @@ class TestServingScrapeE2E:
 
 
 # ==========================================================================
+# connection-plane scrape schema (ISSUE 19): the C100K wire plane's
+# gauge + counters are PRE-created — a zero-traffic scrape already
+# shows the whole schema, so dashboards never see metrics pop into
+# existence mid-incident
+# ==========================================================================
+class TestConnPlaneScrapeSchema:
+    def test_connection_metrics_precreated_at_zero_traffic(self):
+        from bigdl_tpu.frontend import FrontendServer
+        from bigdl_tpu.serving import ModelRegistry
+        srv = AdminServer(port=0)
+        srv.start()
+        admin_mod.install(srv)
+        reg = ModelRegistry()
+        fe = FrontendServer(reg, port=0)
+        try:
+            fe.start()
+            _, text = _get(srv.url("/metrics"))
+            assert ("# TYPE bigdl_tpu_frontend_open_connections gauge"
+                    in text)
+            assert "bigdl_tpu_frontend_open_connections" in text
+            for c in ("conns_accepted", "conns_closed", "conns_reaped",
+                      "conns_refused"):
+                assert f"bigdl_tpu_frontend_{c}" in text, c
+                assert (f"# TYPE bigdl_tpu_frontend_{c} counter"
+                        in text), c
+        finally:
+            fe.stop()
+            reg.stop_all()
+            admin_mod.reset()
+
+
+# ==========================================================================
 # E2E acceptance: replica-kill story in the flight dump
 # ==========================================================================
 class TestFailoverStory:
