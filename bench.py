@@ -1379,6 +1379,15 @@ def serving_bench(smoke: bool = False):
     out["quantized_speedup"] = out["quantized"].get("quantized_speedup")
     if out["quantized"].get("caveat"):
         out["quantized_kernel_caveat"] = out["quantized"]["caveat"]
+    # continuous-batching decode column (ISSUE 20): mixed-length
+    # autoregressive generate sweep through a DecodeService —
+    # tokens/sec, TTFT, inter-token latency, batch occupancy — vs the
+    # static-batch (wave-barriered) baseline schedule
+    out["decode"] = _decode_serving_bench(smoke)
+    out["decode_continuous_vs_static_speedup"] = out["decode"].get(
+        "continuous_vs_static_speedup")
+    if out["decode"].get("caveat"):
+        out["decode_cpu_caveat"] = out["decode"]["caveat"]
     return out
 
 
@@ -1885,6 +1894,206 @@ def _quantized_serving_bench(model, spec, rng, smoke: bool) -> dict:
     ib = out.get("int8", {}).get("bytes_per_step")
     out["bytes_per_step_ratio_int8_vs_f32"] = (
         round(ib / fb, 3) if fb and ib else None)
+    return out
+
+
+def _decode_serving_bench(smoke: bool) -> dict:
+    """Continuous-batching autoregressive decode column (ISSUE 20).
+
+    Offered-load sweep of mixed-length generate requests through ONE
+    :class:`DecodeService` (a 2-layer toy LM; the service AOT-compiles
+    its step + prefill executables once, before any timed window).
+    Closed-loop clients call ``submit(..., on_token=...)`` so TTFT
+    (submit → first token) and inter-token gaps are measured at the
+    CALLER, per request.  Per load point: tokens/sec, TTFT p50/p99,
+    inter-token p50/p99, and window batch occupancy computed from
+    stats deltas (step-tokens over slot-steps — admission-emitted
+    first tokens excluded, they aren't step work).
+
+    ``static_batch`` is the baseline column: the SAME request mix
+    submitted in synchronized waves of ``slots`` requests, each wave
+    barriered on its slowest sequence before the next is offered —
+    exactly what batch-level (non-iteration-level) scheduling does to
+    a decode fleet.  ``continuous_vs_static_speedup`` = continuous
+    tokens/sec at matched offered load / static tokens/sec.
+
+    Record-never-abort: any failure lands in the capture as
+    ``error``.  CPU-host caveat (recorded like
+    ``quantized_kernel_caveat``): off-TPU the per-step dispatch
+    overhead of a toy LM dominates, so absolute tokens/sec and the
+    speedup ratio are schedule-shape evidence, not TPU perf.
+    """
+    import threading as _threading
+
+    import numpy as np
+
+    from bigdl_tpu.models.transformer import transformer_lm
+    from bigdl_tpu.serving import DecodeService
+
+    on_tpu = _toolchain()["platform"] == "tpu"
+    caveat = None if on_tpu else (
+        "cpu-host decode: per-step dispatch overhead dominates a "
+        "2-layer toy LM, so tokens/sec and the continuous-vs-static "
+        "ratio are schedule-shape evidence, not TPU perf; "
+        "shortened load")
+    slots = 4
+    max_new = 4 if smoke else 8
+    per_client = 2 if smoke else 6
+    lens = (2, 4, 6, 9, 12)
+    out = {"unit": "tokens/sec", "slots": slots,
+           "max_new_tokens": max_new, "prompt_lens": list(lens),
+           "caveat": caveat, "sweep": []}
+    try:
+        model = transformer_lm(vocab_size=64, embed_dim=32,
+                               num_heads=4, num_layers=2,
+                               max_len=64).initialize(0)
+        dec = DecodeService(model, slots=slots, max_seq_len=32,
+                            max_prompt_len=12, prefill_buckets="top",
+                            queue_capacity=4096, name="bench-decode")
+    except Exception as e:  # record-never-abort
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
+    rng = np.random.default_rng(7)
+
+    def mk_prompts(n):
+        return [rng.integers(1, 64,
+                             size=lens[i % len(lens)]).tolist()
+                for i in range(n)]
+
+    def snap():
+        d = dec.stats()["decode"]
+        return (d["steps"], d["tokens_generated"], d["admissions"])
+
+    def run_requests(prompts, ttfts, gaps, errs, lock):
+        """Closed loop over ``prompts`` on the calling thread."""
+        for p in prompts:
+            marks = []
+            t0 = time.perf_counter()
+            fut = dec.submit(p, max_new_tokens=max_new,
+                             on_token=lambda i, t, m=marks:
+                                 m.append(time.perf_counter()))
+            try:
+                fut.result(timeout=300)
+            except Exception as e:  # recorded, never dropped
+                with lock:
+                    errs.append(f"{type(e).__name__}: {e}")
+                continue
+            with lock:
+                if marks:
+                    ttfts.append((marks[0] - t0) * 1e3)
+                    gaps.extend((b - a) * 1e3 for a, b in
+                                zip(marks, marks[1:]))
+
+    def pcts(xs):
+        if not xs:
+            return None
+        a = np.asarray(xs)
+        return {"p50": round(float(np.percentile(a, 50)), 3),
+                "p99": round(float(np.percentile(a, 99)), 3)}
+
+    def window(steps0, tok0, adm0):
+        steps1, tok1, adm1 = snap()
+        dsteps = steps1 - steps0
+        step_tokens = (tok1 - tok0) - (adm1 - adm0)
+        occ = (round(step_tokens / (dsteps * slots), 4)
+               if dsteps else None)
+        return (tok1 - tok0), occ
+
+    try:
+        # warm pass: first-token + step executables already AOT-compile
+        # in the ctor, but run one request end-to-end so the timed
+        # windows never see a cold scheduler thread
+        dec.generate(mk_prompts(1)[0], max_new_tokens=2)
+
+        cont_tps_at = {}
+        for n_clients in (2, 8):
+            point = {"offered_clients": n_clients,
+                     "requests": n_clients * per_client}
+            try:
+                ttfts, gaps, errs = [], [], []
+                lock = _threading.Lock()
+                client_prompts = [mk_prompts(per_client)
+                                  for _ in range(n_clients)]
+                barrier = _threading.Barrier(n_clients + 1)
+
+                def worker(ps):
+                    barrier.wait()
+                    run_requests(ps, ttfts, gaps, errs, lock)
+
+                threads = [_threading.Thread(target=worker, args=(ps,))
+                           for ps in client_prompts]
+                for t in threads:
+                    t.start()
+                s0 = snap()
+                barrier.wait()
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                toks, occ = window(*s0)
+                point.update({
+                    "tokens_per_sec": round(toks / wall, 1),
+                    "ttft_ms": pcts(ttfts),
+                    "inter_token_ms": pcts(gaps),
+                    "batch_occupancy": occ,
+                })
+                if errs:
+                    point["errors"] = errs[:3]
+                cont_tps_at[n_clients] = point["tokens_per_sec"]
+            except Exception as e:  # record-never-abort
+                point["error"] = f"{type(e).__name__}: {e}"
+            out["sweep"].append(point)
+
+        # static-batch baseline: waves of `slots` requests, every wave
+        # barriered on its slowest sequence (offered load matches the
+        # slots-saturating sweep point: 8 clients over 4 slots offers
+        # a full wave the moment the previous one clears)
+        static = {"wave_size": slots}
+        try:
+            n_waves = max(1, (8 * per_client) // slots)
+            ttfts, gaps, errs = [], [], []
+            lock = _threading.Lock()
+            waves = [mk_prompts(slots) for _ in range(n_waves)]
+            static["requests"] = n_waves * slots
+            s0 = snap()
+            t0 = time.perf_counter()
+            for wave in waves:
+                ws = [_threading.Thread(
+                    target=run_requests,
+                    args=([p], ttfts, gaps, errs, lock))
+                    for p in wave]
+                for t in ws:
+                    t.start()
+                for t in ws:
+                    t.join()  # the wave barrier: slowest gates all
+            wall = time.perf_counter() - t0
+            toks, occ = window(*s0)
+            static.update({
+                "tokens_per_sec": round(toks / wall, 1),
+                "ttft_ms": pcts(ttfts),
+                "inter_token_ms": pcts(gaps),
+                "batch_occupancy": occ,
+            })
+            if errs:
+                static["errors"] = errs[:3]
+        except Exception as e:  # record-never-abort
+            static["error"] = f"{type(e).__name__}: {e}"
+        out["static_batch"] = static
+
+        st = dec.stats()["decode"]
+        out["step_ms_ewma"] = st["step_ms_ewma"]
+        out["cumulative_step_occupancy"] = st["step_occupancy"]
+        c_tps = cont_tps_at.get(8)
+        s_tps = static.get("tokens_per_sec")
+        out["continuous_vs_static_speedup"] = (
+            round(c_tps / s_tps, 3) if c_tps and s_tps else None)
+    except Exception as e:  # record-never-abort
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            dec.stop(drain=False, timeout=5)
+        except Exception:
+            pass
     return out
 
 

@@ -72,7 +72,10 @@ class HotCutover:
         timeout = (self.drain_timeout_s if drain_timeout_s is None
                    else float(drain_timeout_s))
         old = self.registry.latest_version(name)
-        if old is not None and "input_spec" not in deploy_kw:
+        if old is not None and "input_spec" not in deploy_kw \
+                and "service" not in deploy_kw:
+            # (a prebuilt `service=` deploy owns its own warmup — an
+            # inherited input_spec doesn't apply to it)
             # reuse the incumbent's warmed row spec so the new version
             # AOT-warms at deploy instead of on live traffic
             spec = self.registry.get(name, old).row_spec

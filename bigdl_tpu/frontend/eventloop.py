@@ -39,6 +39,7 @@ import heapq
 import hmac
 import json
 import logging
+import os
 import selectors
 import socket
 import threading
@@ -135,6 +136,18 @@ class _Loop(threading.Thread):
         self._stopping = True
 
     def run(self) -> None:
+        if self.core.pin_cpus:
+            # pin this shard to one CPU (loop i → available cpu i mod
+            # count): shards stop migrating across cores under load.
+            # Silently inert where unsupported (macOS/Windows have no
+            # sched_setaffinity) — the knob is best-effort by contract
+            try:
+                cpus = sorted(os.sched_getaffinity(0))
+                if cpus:
+                    os.sched_setaffinity(
+                        0, {cpus[self.idx % len(cpus)]})
+            except (AttributeError, OSError, ValueError):
+                pass
         self._sel.register(self._wake_r, selectors.EVENT_READ, None)
         if self.listener is not None:
             self._sel.register(self.listener, selectors.EVENT_READ,
@@ -461,13 +474,15 @@ class EventLoopCore:
     server object so both cores share one behavior surface."""
 
     def __init__(self, server, *, host: str, port: int, shards: int = 1,
-                 reuse_port: bool = False, idle_timeout_s: float = 0.0):
+                 reuse_port: bool = False, idle_timeout_s: float = 0.0,
+                 pin_cpus: bool = False):
         self.server = server
         self.host = host
         self.requested_port = int(port)
         self.shards = max(1, int(shards))
         self.reuse_port = bool(reuse_port)
         self.idle_timeout_s = float(idle_timeout_s)
+        self.pin_cpus = bool(pin_cpus)
         self.ledger = server._conns
         self.loops: List[_Loop] = []
         self.port: Optional[int] = None
@@ -559,7 +574,8 @@ class EventLoopCore:
         """Checks that must answer BEFORE the body is read (the
         401/404/411/413 keep-alive desync guards — all of them close).
         True → proceed to body framing; False → responded."""
-        from bigdl_tpu.frontend.server import _MAX_BODY, _PREDICT_RE
+        from bigdl_tpu.frontend.server import (_GENERATE_RE, _MAX_BODY,
+                                               _PREDICT_RE)
         if not self._auth_ok(head):
             self.respond(conn, 401,
                          {"error": "missing or invalid bearer token"},
@@ -572,11 +588,17 @@ class EventLoopCore:
                          {"error": f"unsupported method "
                                    f"{head.method!r}"}, close=True)
             return False
-        if _PREDICT_RE.match(head.target) is None:
+        if _PREDICT_RE.match(head.target) is None \
+                and _GENERATE_RE.match(head.target) is None:
             self.respond(conn, 404,
                          {"error": f"no route {head.target}"},
                          close=True)
             return False
+        if head.get("transfer-encoding"):
+            # chunked framing: the parser's embedded ChunkedDecoder
+            # enforces the whole 400/413/501 taxonomy itself (incl.
+            # the TE+CL smuggling refusal), so no length check here
+            return True
         cl = head.get("content-length")
         try:
             length = int(cl) if cl is not None else -1
@@ -615,6 +637,7 @@ class EventLoopCore:
             conn.close_when_flushed()
 
     def dispatch(self, conn: _Conn, req) -> None:
+        from bigdl_tpu.frontend.server import _GENERATE_RE
         if req.method == "GET":
             if req.target == "/v1/models":
                 self.respond(conn, 200, {"models": self.server.models()},
@@ -624,7 +647,12 @@ class EventLoopCore:
                     "error": f"no route {req.target}",
                     "routes": ["/v1/models",
                                "POST /v1/models/<name>[:<v>]"
-                               "/predict"]}, keep_alive=req.keep_alive)
+                               "/predict",
+                               "POST /v1/models/<name>[:<v>]"
+                               "/generate"]}, keep_alive=req.keep_alive)
+            return
+        if _GENERATE_RE.match(req.target) is not None:
+            _GenExchange(self, conn, req).start()
             return
         _Exchange(self, conn, req).start()
 
@@ -1047,6 +1075,297 @@ class _Exchange:
         if self._retry_timer is not None:
             self._retry_timer.cancel()
             self._retry_timer = None
+
+    def _finish(self, trace_status: int, *, ok: bool) -> None:
+        if self._settled:
+            return
+        self._settled = True
+        self._cancel_timers()
+        self._unpin()
+        server = self.server
+        if self._entered:
+            dt = time.monotonic() - self._t0
+            server.qos.record_result(self.tenant, dt, ok)
+            server._latency_h.observe(dt)
+        if self._span_t0 is not None:
+            tracer = server.tracer
+            tracer.record("wire_request", self._span_t0,
+                          time.perf_counter_ns(), cat="serving",
+                          model=self.name, tenant=self.tenant,
+                          trace_id=self.trace_id)
+            if trace_status != 200:
+                tracer.instant("wire_error", cat="serving",
+                               model=self.name, tenant=self.tenant,
+                               status=trace_status)
+        self.conn.exchange_done(self.req.keep_alive)
+
+
+class _GenExchange:
+    """One POST .../generate exchange — the loop-owned token-streaming
+    twin of the threaded core's ``_run_generate``/``_respond_generate``.
+    Single-owner like :class:`_Exchange`: every field is loop-thread
+    only.  The decode scheduler thread crosses in at exactly two
+    points — the ``on_token`` callback and the future's done callback —
+    and both only ``call_soon`` a bound method; because one scheduler
+    thread emits every token BEFORE settling the future, the ready
+    deque preserves token order and the done entry lands after the last
+    token."""
+
+    def __init__(self, core: EventLoopCore, conn: _Conn, req):
+        from bigdl_tpu.frontend.server import _GENERATE_RE
+        self.core = core
+        self.server = core.server
+        self.conn = conn
+        self.loop = conn.loop
+        self.req = req
+        m = _GENERATE_RE.match(req.target)
+        self.name = m.group("name")
+        self.req_version = (int(m.group("version"))
+                            if m.group("version") else None)
+        self.ctype = (req.get("content-type") or "") \
+            .split(";")[0].strip().lower()
+        self.tenant = req.get("x-tenant")
+        self.trace_id = req.get("x-trace-id")
+        self._settled = False
+        self._entered = False
+        self._t0 = 0.0
+        self._span_t0: Optional[int] = None
+        self._key = None
+        self._pinned = False
+        self._backend = None
+        self._brk = None
+        self._attempt = 0
+        self.deadline: Optional[float] = None
+        self.ctx = None
+        self.prompt = None
+        self.max_new = None
+        self._fut = None
+        self._deadline_timer: Optional[_Timer] = None
+        self._started = False
+        self._sent = 0
+
+    # -- entry -------------------------------------------------------------
+    def start(self) -> None:
+        server = self.server
+        raw_deadline = self.req.get("x-deadline-ms")
+        deadline_ms = None
+        if raw_deadline is not None:
+            try:
+                deadline_ms = float(raw_deadline)
+            except ValueError:
+                self.core.respond(self.conn, 400,
+                                  {"error": f"bad X-Deadline-Ms "
+                                            f"{raw_deadline!r}"},
+                                  keep_alive=self.req.keep_alive)
+                return
+        tracer = server.tracer
+        if tracer is not None and tracer.enabled:
+            if self.trace_id is None:
+                from bigdl_tpu.telemetry.context import new_trace_id
+                self.trace_id = new_trace_id()
+            self._span_t0 = time.perf_counter_ns()
+        self.conn.exchange = self
+        self._t0 = time.monotonic()
+        server.metrics.counter("frontend/requests").inc()
+        try:
+            server.qos.admit(self.tenant)
+            self.deadline = (self._t0 + deadline_ms / 1e3
+                             if deadline_ms is not None else None)
+            from bigdl_tpu.telemetry.context import RequestContext
+            self.ctx = RequestContext(trace_id=self.trace_id,
+                                      tenant=self.tenant,
+                                      deadline=self.deadline)
+            server._resolve(self.name, self.req_version)  # 404 first
+            self.prompt, self.max_new = server._parse_generate_body(
+                self.req.body, self.ctype)
+        except BaseException as e:
+            self._finish_error(e)
+            return
+        self._entered = True
+        self._begin_attempt()
+
+    # -- resolve-and-pin (the ServiceClosed cutover retry) -----------------
+    def _begin_attempt(self) -> None:
+        from bigdl_tpu.frontend.server import _HTTPError
+        from bigdl_tpu.serving.batcher import RequestSpecError
+        server = self.server
+        try:
+            key, backend, brk = server._resolve_pinned(self.name,
+                                                       self.req_version)
+        except BaseException as e:
+            self._finish_error(e)
+            return
+        self._key, self._backend, self._brk = key, backend, brk
+        self._pinned = True
+        if not getattr(backend, "is_decode_backend", False):
+            self._fail(_HTTPError(
+                400, f"model {self.name!r} is not a decode backend — "
+                     f"use /predict"))
+            return
+        try:
+            fut = backend.submit(self.prompt,
+                                 max_new_tokens=self.max_new,
+                                 deadline=self.deadline, ctx=self.ctx,
+                                 on_token=self._on_token_threadsafe)
+        except RequestSpecError as e:
+            self._fail(_HTTPError(400, str(e)))
+            return
+        except BaseException as e:
+            self._fail(e)
+            return
+        self._fut = fut
+        if self.deadline is not None:
+            self._deadline_timer = self.loop.call_at(
+                self.deadline, self._on_deadline)
+        fut.add_done_callback(
+            lambda f: self.loop.call_soon(self._done, f))
+
+    # -- token stream ------------------------------------------------------
+    def _on_token_threadsafe(self, index: int, token: int) -> None:
+        """Runs on the decode scheduler thread — the ONE rule is it
+        only crosses via call_soon (single-owner discipline)."""
+        self.loop.call_soon(self._on_token, int(index), int(token))
+
+    def _on_token(self, index: int, token: int) -> None:
+        if self._settled or self.conn.closed:
+            return
+        self._ensure_started()
+        self.conn.write(encode_chunk(json.dumps(
+            {"index": index, "token": token}).encode("utf-8") + b"\n"))
+        self._sent += 1
+
+    def _ensure_started(self) -> None:
+        if self._started:
+            return
+        from bigdl_tpu.frontend.server import _NDJSON
+        self._started = True
+        self.conn.write(render_head(
+            200, {"Content-Type": _NDJSON,
+                  "X-Trace-Id": self.ctx.trace_id,
+                  "X-Model-Version": str(self._key[1])}, chunked=True))
+
+    # -- completion --------------------------------------------------------
+    def _done(self, fut) -> None:
+        if self._settled:
+            return
+        from bigdl_tpu.serving.registry import ModelRegistry
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+            self._deadline_timer = None
+        try:
+            out = self.server._result_or_504(fut, 0)  # done: no block
+        except BaseException as e:
+            if not fut.cancelled():
+                ModelRegistry.record_outcome(self._brk, e)
+            self._fail(e)
+            return
+        ModelRegistry.record_outcome(self._brk, None)
+        self._ensure_started()
+        self.conn.write(encode_chunk(json.dumps(
+            {"done": True,
+             "tokens": [int(t) for t in out.tokens],
+             "n": len(out.tokens),
+             "finish_reason": out.finish_reason,
+             "trace_id": self.ctx.trace_id}).encode("utf-8") + b"\n"))
+        self.conn.write(CHUNK_TRAILER)
+        self.server._count_status(200)
+        self.server.metrics.counter(
+            "frontend/generate_tokens").inc(self._sent)
+        self._finish(200, ok=True)
+
+    def _on_deadline(self) -> None:
+        if self._settled:
+            return
+        fut = self._fut
+        if fut is not None and fut.cancel():
+            # still queued past the wire deadline: refuse late service
+            # (a RUNNING sequence is failed by the scheduler's own
+            # per-step deadline check, which settles the future)
+            from bigdl_tpu.serving.batcher import DeadlineExceeded
+            self._fail(DeadlineExceeded(
+                "wire deadline expired while the prompt was queued"))
+
+    # -- failure tails -----------------------------------------------------
+    def _fail(self, e: BaseException) -> None:
+        """Real status if the 200 was never committed (incl. the
+        cutover ServiceClosed retry), else an error line + trailer —
+        the threaded ``_respond_generate`` failure tail, loop-shaped."""
+        from bigdl_tpu.frontend.server import _HTTPError
+        from bigdl_tpu.serving.batcher import ServiceClosed
+        if self._settled:
+            return
+        if self._fut is not None:
+            self._fut.cancel()
+        if not self._started:
+            self._unpin()
+            self._cancel_timers()
+            if isinstance(e, ServiceClosed) \
+                    and self.req_version is None and self._attempt < 2:
+                self._attempt += 1
+                self._fut = None
+                self._begin_attempt()
+                return
+            self._finish_error(e)
+            return
+        if isinstance(e, ConnectionError):
+            self.server.metrics.counter(
+                "frontend/client_disconnects").inc()
+            self._finish(200, ok=False)
+            return
+        status, body, _hdrs = self.server._classify(e)
+        if status >= 500 and status != 504 \
+                and not isinstance(e, _HTTPError):
+            logger.error("frontend mid-generate 5xx after %d tokens",
+                         self._sent, exc_info=e)
+        self.server._count_status(status)
+        self.conn.write(encode_chunk(json.dumps(
+            {"error": body["error"], "status": status,
+             "tokens_streamed": self._sent}).encode("utf-8") + b"\n"))
+        self.conn.write(CHUNK_TRAILER)
+        self._finish(200, ok=False)
+
+    def _finish_error(self, e: BaseException) -> None:
+        from bigdl_tpu.frontend.server import _HTTPError
+        status, body, hdrs = self.server._classify(e)
+        if status >= 500 and status != 504 \
+                and not isinstance(e, _HTTPError):
+            logger.error("frontend 5xx on %s", self.req.target,
+                         exc_info=e)
+        self.core.respond(self.conn, status, body, hdrs)
+        self._finish(status, ok=False)
+
+    # -- conn-driven notifications ----------------------------------------
+    def on_drain(self) -> None:
+        pass  # token lines are tiny; no pull-driven pump to resume
+
+    def on_client_gone(self) -> None:
+        if self._settled:
+            return
+        if self._started:
+            self._fail(ConnectionError(
+                "client disconnected mid-generate"))
+        # not started: let the exchange complete — writes are dropped
+        # by the closed conn (same contract as _Exchange)
+
+    def abandon(self) -> None:
+        if self._settled:
+            return
+        self._settled = True
+        self._cancel_timers()
+        if self._fut is not None:
+            self._fut.cancel()
+        self._unpin()
+
+    # -- bookkeeping -------------------------------------------------------
+    def _unpin(self) -> None:
+        if self._pinned:
+            self._pinned = False
+            self.server.inflight.exit(self._key)  # releases: wire_inflight
+
+    def _cancel_timers(self) -> None:
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+            self._deadline_timer = None
 
     def _finish(self, trace_status: int, *, ok: bool) -> None:
         if self._settled:

@@ -8,10 +8,13 @@ parsed structures, return ``None`` (need more bytes — the slow-loris
 case: a byte-dribbled request line parks the CONNECTION, never a
 thread or a loop tick), or raise :class:`ProtocolError` carrying the
 HTTP status the connection should die with.  Body framing is
-Content-Length only — the same surface the threaded core speaks
-(chunked REQUEST bodies were never accepted there either; the value is
-validated and refused at the exchange layer so the 400/411/413 error
-taxonomy matches the threaded core byte for byte).
+Content-Length or ``Transfer-Encoding: chunked``: chunked request
+bodies are de-chunked INCREMENTALLY by :class:`ChunkedDecoder` — one
+state machine shared by both connection cores (this parser embeds it;
+the threaded core drives the same machine over its blocking ``rfile``
+via :func:`read_chunked_body`) — with malformed chunk framing answered
+400 and the total de-chunked body bounded (413, the body-phase twin of
+the 431 head cap, so a chunk stream can't buffer unboundedly).
 
 Keep-alive semantics follow the RFC defaults the stdlib handler uses:
 HTTP/1.1 persists unless ``Connection: close``; HTTP/1.0 closes unless
@@ -33,6 +36,14 @@ from typing import Dict, Optional
 # error (431), not a reason to buffer unboundedly — the slow-loris
 # memory bound for the head phase
 MAX_HEAD_BYTES = 64 << 10
+
+# chunk-size lines are tiny (hex length + optional extensions); a line
+# past this is framing garbage, not a big chunk
+MAX_CHUNK_LINE = 256
+
+# default total-body cap for chunked requests — matches the frontend's
+# Content-Length 413 cap so the two framing modes share one bound
+MAX_BODY_BYTES = 256 << 20
 
 
 class ProtocolError(Exception):
@@ -80,16 +91,158 @@ def _body_length(headers: Dict[str, str]) -> int:
     return n if n > 0 else 0
 
 
+class ChunkedDecoder:
+    """Incremental ``Transfer-Encoding: chunked`` request-body decoder —
+    the ONE chunk-framing state machine both connection cores share.
+    The event-loop :class:`RequestParser` embeds it (feed bytes, poll);
+    the threaded core drives the same instance over its blocking
+    ``rfile`` through :func:`read_chunked_body`.
+
+    ``feed(bytes)`` appends; ``poll()`` advances the machine and
+    returns the complete de-chunked body once the terminal chunk and
+    its (discarded) trailer section arrive, else ``None``.  Malformed
+    framing raises :class:`ProtocolError` 400; a stream whose
+    de-chunked total exceeds ``max_body`` raises 413 — the body-phase
+    twin of the head's 431 cap.  Bytes past the body's end (pipelined
+    next request) stay in ``residual()``.
+    """
+
+    __slots__ = ("_max_body", "_buf", "_body", "_mode", "_remaining")
+
+    def __init__(self, max_body: int = MAX_BODY_BYTES):
+        self._max_body = int(max_body)
+        self._buf = bytearray()
+        self._body = bytearray()
+        # size → data → crlf → size … → trailer → (returns)
+        self._mode = "size"
+        self._remaining = 0
+
+    def feed(self, data: bytes) -> None:
+        if data:
+            self._buf += data
+
+    def residual(self) -> bytes:
+        """Unconsumed bytes past the body's end (only meaningful after
+        ``poll()`` returned the body)."""
+        return bytes(self._buf)
+
+    # hints for a BLOCKING driver (read_chunked_body): what to read next
+    def wants_line(self) -> bool:
+        return self._mode != "data"
+
+    def bytes_needed(self) -> int:
+        """In data mode: exact payload bytes still owed to the current
+        chunk (drivers may read less; never read more than this plus
+        the trailing CRLF)."""
+        return self._remaining
+
+    def _take_line(self, cap: int) -> Optional[str]:
+        nl = self._buf.find(b"\n")
+        if nl < 0:
+            if len(self._buf) > cap:
+                raise ProtocolError(
+                    400, "malformed chunk framing: oversized line")
+            return None
+        if nl > cap:
+            raise ProtocolError(
+                400, "malformed chunk framing: oversized line")
+        line = bytes(self._buf[:nl])
+        del self._buf[:nl + 1]
+        return line.rstrip(b"\r").decode("latin-1")
+
+    def poll(self) -> Optional[bytes]:
+        while True:
+            if self._mode == "size":
+                line = self._take_line(MAX_CHUNK_LINE)
+                if line is None:
+                    return None
+                # chunk extensions (";ext=val") are legal; discard them
+                size_tok = line.split(";", 1)[0].strip()
+                try:
+                    n = int(size_tok, 16)
+                except ValueError:
+                    raise ProtocolError(
+                        400, f"malformed chunk framing: bad chunk size "
+                             f"{size_tok!r}") from None
+                if n < 0:
+                    raise ProtocolError(
+                        400, "malformed chunk framing: negative size")
+                if n == 0:
+                    self._mode = "trailer"
+                    continue
+                if len(self._body) + n > self._max_body:
+                    raise ProtocolError(
+                        413, f"chunked body exceeds the "
+                             f"{self._max_body} byte cap")
+                self._remaining = n
+                self._mode = "data"
+            elif self._mode == "data":
+                if not self._buf:
+                    return None
+                take = min(len(self._buf), self._remaining)
+                self._body += self._buf[:take]
+                del self._buf[:take]
+                self._remaining -= take
+                if self._remaining:
+                    return None
+                self._mode = "crlf"
+            elif self._mode == "crlf":
+                # each chunk's payload is followed by a bare CRLF
+                line = self._take_line(2)
+                if line is None:
+                    return None
+                if line:
+                    raise ProtocolError(
+                        400, "malformed chunk framing: missing chunk "
+                             "terminator")
+                self._mode = "size"
+            else:  # trailer: zero or more fields, then an empty line
+                line = self._take_line(MAX_CHUNK_LINE)
+                if line is None:
+                    return None
+                if line:
+                    continue  # trailer field — legal, discarded
+                body = bytes(self._body)
+                self._body.clear()
+                return body
+
+
+def read_chunked_body(rfile, max_body: int = MAX_BODY_BYTES) -> bytes:
+    """Drive :class:`ChunkedDecoder` over a BLOCKING file-like (the
+    threaded core's buffered ``rfile``) — same state machine, same 400 /
+    413 taxonomy as the event-loop core.  Reads exactly the body's
+    bytes: size/terminator/trailer lines via bounded ``readline`` and
+    chunk payloads via exact-length ``read``, so pipelined keep-alive
+    bytes after the body are never consumed."""
+    dec = ChunkedDecoder(max_body)
+    while True:
+        body = dec.poll()
+        if body is not None:
+            return body
+        if dec.wants_line():
+            # +1 for the \n; a line hitting the cap without one is
+            # flagged by the decoder itself
+            data = rfile.readline(MAX_CHUNK_LINE + 2)
+        else:
+            data = rfile.read(min(dec.bytes_needed(), 64 << 10))
+        if not data:
+            raise ProtocolError(400, "truncated chunked body")
+        dec.feed(data)
+
+
 class RequestParser:
     """Incremental request parser: ``feed(bytes)`` → ``head()`` /
     ``poll()``.  Once a :class:`ProtocolError` is raised the parser is
     poisoned (every later call re-raises): the connection is done."""
 
-    def __init__(self, max_head: int = MAX_HEAD_BYTES):
+    def __init__(self, max_head: int = MAX_HEAD_BYTES,
+                 max_body: int = MAX_BODY_BYTES):
         self._max_head = int(max_head)
+        self._max_body = int(max_body)
         self._buf = bytearray()
         self._head: Optional[Request] = None
         self._body_len = 0
+        self._chunked: Optional[ChunkedDecoder] = None
         self._error: Optional[ProtocolError] = None
 
     def feed(self, data: bytes) -> None:
@@ -114,11 +267,29 @@ class RequestParser:
         return self._head
 
     def poll(self) -> Optional[Request]:
-        """A COMPLETE request (head + Content-Length body) or
-        ``None``; returning one resets the machine for the next
-        request on the same connection."""
+        """A COMPLETE request (head + body, Content-Length or chunked
+        framing) or ``None``; returning one resets the machine for the
+        next request on the same connection."""
         req = self.head()
-        if req is None or len(self._buf) < self._body_len:
+        if req is None:
+            return None
+        if self._chunked is not None:
+            # hand every buffered byte to the shared chunk machine;
+            # whatever follows the body comes back via residual()
+            self._chunked.feed(bytes(self._buf))
+            self._buf.clear()
+            try:
+                body = self._chunked.poll()
+            except ProtocolError as e:
+                self._fail(e.status, str(e))
+            if body is None:
+                return None
+            self._buf += self._chunked.residual()
+            req.body = body
+            self._head = None
+            self._chunked = None
+            return req
+        if len(self._buf) < self._body_len:
             return None
         req.body = bytes(self._buf[:self._body_len])
         del self._buf[:self._body_len]
@@ -174,7 +345,21 @@ class RequestParser:
                       else "keep-alive" in conn_toks)
         self._head = Request(method, target, version, headers,
                              keep_alive)
-        self._body_len = _body_length(headers)
+        te = headers.get("transfer-encoding", "").lower().strip()
+        if te:
+            # a CL alongside TE is the request-smuggling classic
+            # (RFC 9112 §6.1 MUST treat as an error); any coding other
+            # than a single terminal "chunked" we don't implement
+            if "content-length" in headers:
+                self._fail(400, "both Content-Length and "
+                                "Transfer-Encoding present")
+            if te != "chunked":
+                self._fail(501, f"unsupported transfer coding {te!r}")
+            self._body_len = 0
+            self._chunked = ChunkedDecoder(self._max_body)
+        else:
+            self._body_len = _body_length(headers)
+            self._chunked = None
 
 
 # -- response encoding (the write half of the wire) ------------------------

@@ -86,6 +86,8 @@ logger = logging.getLogger("bigdl_tpu.frontend")
 
 _PREDICT_RE = re.compile(
     r"^/v1/models/(?P<name>[^/:]+)(?::(?P<version>\d+))?/predict$")
+_GENERATE_RE = re.compile(
+    r"^/v1/models/(?P<name>[^/:]+)(?::(?P<version>\d+))?/generate$")
 _NPY = "application/x-npy"
 _NDJSON = "application/x-ndjson"
 _MAX_BODY = 256 << 20  # refuse absurd Content-Length up front
@@ -279,7 +281,8 @@ class FrontendServer:
                  shards: Optional[int] = None,
                  max_connections: Optional[int] = None,
                  idle_timeout_s: Optional[float] = None,
-                 reuse_port: bool = False):
+                 reuse_port: bool = False,
+                 pin_cpus: Optional[bool] = None):
         if port is None:
             from bigdl_tpu.utils.config import get_config
             port = int(getattr(get_config(), "frontend_port", 0) or 0)
@@ -350,6 +353,9 @@ class FrontendServer:
                 _cfg, "frontend_idle_timeout_s", 0.0) or 0.0)
         self._idle_timeout_s = max(0.0, float(idle_timeout_s))
         self._reuse_port = bool(reuse_port)
+        if pin_cpus is None:
+            pin_cpus = bool(getattr(_cfg, "frontend_pin_cpus", False))
+        self._pin_cpus = bool(pin_cpus)
         self._lock = threading.Lock()
         self._backends: Dict[str, object] = dict(backends or {})  # guarded-by: _lock
         self.inflight = _WireInflight()
@@ -359,7 +365,8 @@ class FrontendServer:
         # counters pre-created so a zero-traffic scrape shows the schema
         for c in ("requests", "responses_2xx", "responses_4xx",
                   "responses_5xx", "sheds", "deadline_504",
-                  "stream_chunks", "client_disconnects"):
+                  "stream_chunks", "generate_tokens",
+                  "client_disconnects"):
             self.metrics.counter(f"frontend/{c}")
         self._latency_h = self.metrics.histogram("frontend/wire_latency_s")
         # connection-plane schema (gauge + counters) pre-created too
@@ -552,6 +559,194 @@ class FrontendServer:
             raise _HTTPError(400, "inputs must have a leading batch "
                                   "dim") from None
         return x, rows
+
+    @staticmethod
+    def _parse_generate_body(body: bytes, ctype: str):
+        """Generate request body → ``(prompt 1-D int array, max_new or
+        None)``.  JSON only: ``{"prompt": [ints],
+        "max_new_tokens": n?}`` — token streams have no npy bulk
+        form."""
+        if ctype == _NPY:
+            raise _HTTPError(400, "generate takes a JSON body "
+                                  '({"prompt": [...]}), not npy')
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except ValueError as e:
+            raise _HTTPError(
+                400, f"unreadable JSON body: {e}") from None
+        if not isinstance(payload, dict) or "prompt" not in payload:
+            raise _HTTPError(400, 'JSON body must be {"prompt": ...}')
+        try:
+            prompt = np.asarray(payload["prompt"], dtype=np.int64)
+        except (ValueError, TypeError) as e:
+            raise _HTTPError(
+                400, f"unparseable prompt: {e}") from None
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise _HTTPError(400, "prompt must be a non-empty 1-D "
+                                  "token list")
+        max_new = payload.get("max_new_tokens")
+        if max_new is not None:
+            if not isinstance(max_new, int) or max_new < 1:
+                raise _HTTPError(
+                    400, f"max_new_tokens must be a positive int, got "
+                         f"{max_new!r}")
+        return prompt, max_new
+
+    def _run_generate(self, handler, name, version, body, ctype,
+                      tenant, deadline_ms, trace_id) -> None:
+        """The whole exchange for one POST .../generate — the decode
+        twin of :meth:`_run_predict` (same QoS admission, pinning,
+        cutover-retry and accounting shape)."""
+        t0 = time.monotonic()
+        self.metrics.counter("frontend/requests").inc()
+        self.qos.admit(tenant)
+        deadline = (t0 + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        ctx = RequestContext(trace_id=trace_id, tenant=tenant,
+                             deadline=deadline)
+        self._resolve(name, version)  # 404 precedence
+        prompt, max_new = self._parse_generate_body(body, ctype)
+        ok = False
+        try:
+            for attempt in range(3):
+                key, backend, brk = self._resolve_pinned(name, version)
+                try:  # pin held: EVERY exit path below must unpin
+                    if not getattr(backend, "is_decode_backend",
+                                   False):
+                        raise _HTTPError(
+                            400, f"model {name!r} is not a decode "
+                                 f"backend — use /predict")
+                    ok = self._respond_generate(
+                        handler, key, backend, prompt, max_new,
+                        deadline, ctx, brk)
+                    break
+                except ServiceClosed:
+                    # cutover closed the pinned version before any
+                    # token was streamed — re-resolve the successor
+                    # (same idempotency argument as _run_predict)
+                    if attempt == 2 or version is not None:
+                        raise
+                finally:
+                    self.inflight.exit(key)
+        finally:
+            self.qos.record_result(tenant, time.monotonic() - t0, ok)
+            self._latency_h.observe(time.monotonic() - t0)
+
+    def _respond_generate(self, handler, key, backend, prompt,
+                          max_new, deadline, ctx, brk) -> bool:
+        """Token streaming for one decode request: ndjson over chunked
+        transfer, one ``{"index", "token"}`` line per generated token
+        IN ORDER, closed by a ``{"done": true, "tokens": [...]}``
+        trailer carrying the full sequence.  The 200 chunked header is
+        committed only at the FIRST token, so pre-stream failures
+        (shed, deadline, cutover close) still get their real status.
+        The decode scheduler thread hands tokens to this handler
+        thread through a Queue — ``on_token`` never blocks the
+        scheduler on a slow reader."""
+        import queue as _queue
+
+        from bigdl_tpu.serving.registry import ModelRegistry
+        _name, version = key
+        started = [False]
+
+        def ensure_started():
+            if not started[0]:
+                handler.start_chunked(
+                    200, _NDJSON,
+                    {"X-Trace-Id": ctx.trace_id,
+                     "X-Model-Version": str(version)})
+                started[0] = True
+
+        tokens_q: "_queue.Queue" = _queue.Queue()
+
+        def on_token(index: int, token: int) -> None:
+            tokens_q.put((index, int(token)))
+
+        try:
+            fut = backend.submit(prompt, max_new_tokens=max_new,
+                                 deadline=deadline, ctx=ctx,
+                                 on_token=on_token)
+        except RequestSpecError as e:
+            raise _HTTPError(400, str(e)) from None
+        # ServiceOverloaded propagates untouched (never a breaker
+        # outcome — same contract as _predict_once)
+        sent = 0
+
+        def stream_line(index: int, token: int) -> None:
+            ensure_started()
+            handler.send_chunk(json.dumps(
+                {"index": index, "token": token}).encode() + b"\n")
+
+        try:
+            while not fut.done():
+                try:
+                    idx, tok = tokens_q.get(timeout=0.05)
+                except _queue.Empty:
+                    if deadline is not None \
+                            and time.monotonic() >= deadline \
+                            and fut.cancel():
+                        # still queued past the wire deadline: refuse
+                        # late service (a running sequence is failed
+                        # by the scheduler's own deadline check)
+                        raise DeadlineExceeded(
+                            "wire deadline expired while the prompt "
+                            "was queued")
+                    continue
+                stream_line(idx, tok)
+                sent += 1
+            # every token is enqueued before the future settles, so a
+            # final non-blocking drain empties the stream
+            while True:
+                try:
+                    idx, tok = tokens_q.get_nowait()
+                except _queue.Empty:
+                    break
+                stream_line(idx, tok)
+                sent += 1
+            try:
+                res = self._result_or_504(fut, 0)  # done: no block
+            except BaseException as e:
+                if not fut.cancelled():
+                    ModelRegistry.record_outcome(brk, e)
+                raise
+            ModelRegistry.record_outcome(brk, None)
+            ensure_started()
+            handler.send_chunk(json.dumps(
+                {"done": True,
+                 "tokens": [int(t) for t in res.tokens],
+                 "n": len(res.tokens),
+                 "finish_reason": res.finish_reason,
+                 "trace_id": ctx.trace_id}).encode() + b"\n")
+            self._count_status(200)
+            self.metrics.counter("frontend/generate_tokens").inc(sent)
+            return True
+        except BaseException as e:
+            fut.cancel()
+            if not started[0]:
+                raise  # real status (and the cutover retry) upstream
+            if isinstance(e, ConnectionError):
+                self.metrics.counter(
+                    "frontend/client_disconnects").inc()
+                return False
+            status, body_, _hdrs = self._classify(e)
+            if status >= 500 and status != 504 \
+                    and not isinstance(e, _HTTPError):
+                logger.exception(
+                    "frontend mid-generate 5xx after %d tokens", sent)
+            self._count_status(status)
+            try:
+                handler.send_chunk(json.dumps(
+                    {"error": body_["error"], "status": status,
+                     "tokens_streamed": sent}).encode() + b"\n")
+            except ConnectionError:
+                pass
+            return False
+        finally:
+            if started[0]:
+                try:
+                    handler.end_chunked()
+                except ConnectionError:
+                    pass
 
     def _run_predict(self, handler, name, version, body, ctype,
                      accept, tenant, deadline_ms, trace_id) -> None:
@@ -802,7 +997,8 @@ class FrontendServer:
             self._elc = EventLoopCore(
                 self, host=self.host, port=self.requested_port,
                 shards=self._shards, reuse_port=self._reuse_port,
-                idle_timeout_s=self._idle_timeout_s)
+                idle_timeout_s=self._idle_timeout_s,
+                pin_cpus=self._pin_cpus)
             self.port = self._elc.start()
             logger.info(
                 "wire frontend listening on http://%s:%d "
@@ -916,13 +1112,17 @@ class FrontendServer:
                         "error": f"no route {self.path}",
                         "routes": ["/v1/models",
                                    "POST /v1/models/<name>[:<v>]"
-                                   "/predict"]})
+                                   "/predict",
+                                   "POST /v1/models/<name>[:<v>]"
+                                   "/generate"]})
 
             def do_POST(self):  # noqa: N802 - stdlib API
                 if not self.check_auth():
                     return
                 m = _PREDICT_RE.match(self.path)
-                if m is None:
+                gen = None if m is not None \
+                    else _GENERATE_RE.match(self.path)
+                if m is None and gen is None:
                     # the request body is never read on this path — a
                     # keep-alive stream would parse it as the next
                     # request line, so close (same guard as 411/413)
@@ -932,20 +1132,45 @@ class FrontendServer:
                     return
                 body_read = False
                 try:
-                    try:
-                        length = int(self.headers.get("Content-Length",
-                                                      -1))
-                    except ValueError:
-                        raise _HTTPError(
-                            400, "unreadable Content-Length") from None
-                    if length < 0:
-                        raise _HTTPError(
-                            411, "Content-Length required")
-                    if length > _MAX_BODY:
-                        raise _HTTPError(
-                            413, f"body of {length} bytes exceeds the "
-                                 f"{_MAX_BODY} byte cap")
-                    body = self.rfile.read(length)
+                    te = (self.headers.get("Transfer-Encoding")
+                          or "").strip().lower()
+                    if te:
+                        # chunked request bodies: drive the SAME
+                        # incremental de-chunker the event-loop parser
+                        # embeds over this core's blocking rfile
+                        from bigdl_tpu.frontend.http1 import (
+                            ProtocolError, read_chunked_body)
+                        if self.headers.get("Content-Length") \
+                                is not None:
+                            raise _HTTPError(
+                                400, "both Content-Length and "
+                                     "Transfer-Encoding present")
+                        if te != "chunked":
+                            raise _HTTPError(
+                                501, f"unsupported transfer coding "
+                                     f"{te!r}")
+                        try:
+                            body = read_chunked_body(self.rfile,
+                                                     _MAX_BODY)
+                        except ProtocolError as e:
+                            raise _HTTPError(e.status,
+                                             str(e)) from None
+                    else:
+                        try:
+                            length = int(self.headers.get(
+                                "Content-Length", -1))
+                        except ValueError:
+                            raise _HTTPError(
+                                400, "unreadable "
+                                     "Content-Length") from None
+                        if length < 0:
+                            raise _HTTPError(
+                                411, "Content-Length required")
+                        if length > _MAX_BODY:
+                            raise _HTTPError(
+                                413, f"body of {length} bytes exceeds "
+                                     f"the {_MAX_BODY} byte cap")
+                        body = self.rfile.read(length)
                     body_read = True
                     deadline_ms = self.headers.get("X-Deadline-Ms")
                     if deadline_ms is not None:
@@ -955,16 +1180,26 @@ class FrontendServer:
                             raise _HTTPError(
                                 400, f"bad X-Deadline-Ms "
                                      f"{deadline_ms!r}") from None
-                    version = m.group("version")
-                    server._traced_predict(
-                        self, m.group("name"),
-                        int(version) if version else None, body,
-                        (self.headers.get("Content-Type") or
-                         "").split(";")[0].strip().lower(),
-                        (self.headers.get("Accept") or
-                         "").split(",")[0].strip().lower(),
-                        self.headers.get("X-Tenant"), deadline_ms,
-                        self.headers.get("X-Trace-Id"))
+                    route = m if m is not None else gen
+                    version = route.group("version")
+                    ctype = (self.headers.get("Content-Type") or
+                             "").split(";")[0].strip().lower()
+                    if m is not None:
+                        server._traced_predict(
+                            self, m.group("name"),
+                            int(version) if version else None, body,
+                            ctype,
+                            (self.headers.get("Accept") or
+                             "").split(",")[0].strip().lower(),
+                            self.headers.get("X-Tenant"), deadline_ms,
+                            self.headers.get("X-Trace-Id"))
+                    else:
+                        server._traced_generate(
+                            self, gen.group("name"),
+                            int(version) if version else None, body,
+                            ctype, self.headers.get("X-Tenant"),
+                            deadline_ms,
+                            self.headers.get("X-Trace-Id"))
                 except ConnectionError:
                     # client went away mid-exchange (pipe break OR
                     # hard reset) — nothing to send, and letting it
@@ -1040,6 +1275,36 @@ class FrontendServer:
                     self._run_predict(handler, name, version, body,
                                       ctype, accept, tenant,
                                       deadline_ms, trace_id)
+                except BaseException as e:
+                    status_box["status"] = self._classify(e)[0]
+                    raise
+        finally:
+            if status_box["status"] != 200:
+                tracer.instant("wire_error", cat="serving",
+                               model=name, tenant=tenant,
+                               status=status_box["status"])
+
+    def _traced_generate(self, handler, name, version, body, ctype,
+                         tenant, deadline_ms, trace_id) -> None:
+        """Span-wrapping twin of :meth:`_traced_predict` for the
+        generate route (same mint-here trace-id reasoning)."""
+        tracer = self.tracer
+        if tracer is None or not tracer.enabled:
+            self._run_generate(handler, name, version, body, ctype,
+                               tenant, deadline_ms, trace_id)
+            return
+        if trace_id is None:
+            from bigdl_tpu.telemetry.context import new_trace_id
+            trace_id = new_trace_id()
+        status_box = {"status": 200}
+        try:
+            with tracer.span("wire_request", cat="serving",
+                             model=name, tenant=tenant,
+                             trace_id=trace_id):
+                try:
+                    self._run_generate(handler, name, version, body,
+                                       ctype, tenant, deadline_ms,
+                                       trace_id)
                 except BaseException as e:
                     status_box["status"] = self._classify(e)[0]
                     raise

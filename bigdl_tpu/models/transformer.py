@@ -11,6 +11,9 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
+
 from bigdl_tpu import nn
 from bigdl_tpu.nn.attention import LayerNorm, MultiHeadAttention
 
@@ -71,3 +74,168 @@ def transformer_lm(vocab_size: int = 32000, embed_dim: int = 512,
     m.add(nn.TimeDistributed(nn.Linear(embed_dim, vocab_size)))
     m.add(nn.LogSoftMax())
     return m
+
+
+# --------------------------------------------------------------- decode path
+#
+# KV-cache carry for autoregressive serving (``serving/decode.py``).  The
+# functions below re-run the exact per-layer math of the modules built by
+# :func:`transformer_lm` — same projection weights, same f32 softmax/LN
+# statistics — but carry per-layer K/V caches so a decode step touches one
+# token instead of the whole context.  Equality with the full-context
+# ``model.apply`` is tight-allclose, not bitwise: the attention GEMMs run
+# at different shapes (Tq=1 vs Tq=T), so XLA's reduction order differs
+# (the PR-16 cross-shape numerics precedent; gated in
+# ``tests/test_decode_serving.py``).
+#
+# Cache layout: k/v each ``(L, S, H, T_max, Dh)`` — L layers, S slots,
+# H heads.  ``lengths[s]`` tokens are valid in slot ``s``; positions at or
+# beyond ``lengths[s]`` hold garbage (padded prefill leftovers) and are
+# never attended because the causal mask cuts at the query's absolute
+# position.
+
+def lm_layout(model):
+    """Structural handles into a :func:`transformer_lm` Sequential:
+    ``(embed, pos, blocks, final_ln, head, mha0)`` module refs.  Raises
+    if ``model`` does not have the transformer_lm layout."""
+    mods = model.modules
+    if len(mods) < 6:
+        raise ValueError("not a transformer_lm: too few modules")
+    embed, pos = mods[0], mods[1]
+    blocks = mods[2:len(mods) - 3]
+    final_ln, head = mods[-3], mods[-2]
+    if not isinstance(embed, nn.LookupTable) or not blocks:
+        raise ValueError("not a transformer_lm layout")
+    # block = Seq[Seq[ConcatTable[attn_seq, Id], CAdd], Seq[...mlp...]]
+    mha0 = blocks[0].modules[0].modules[0].modules[0].modules[1]
+    if not isinstance(mha0, MultiHeadAttention):
+        raise ValueError("not a transformer_lm layout (no MHA in block)")
+    return embed, pos, blocks, final_ln, head, mha0
+
+
+def kv_cache_spec(model, slots: int, max_len: int):
+    """(shape, dtype) of ONE of the k/v caches for ``model``:
+    ``(L, slots, H, max_len, Dh)`` f32.  The declared-budget sizing in
+    ``serving/decode.py`` prices exactly two of these."""
+    _, _, blocks, _, _, mha = lm_layout(model)
+    return ((len(blocks), slots, mha.num_heads, max_len, mha.head_dim),
+            jnp.float32)
+
+
+def init_kv_cache(model, slots: int, max_len: int):
+    """Zeroed (k, v) cache pair sized by :func:`kv_cache_spec`."""
+    shape, dtype = kv_cache_spec(model, slots, max_len)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _block_attn(mha: MultiHeadAttention, ap, h, k_cache, v_cache, pos_ids):
+    """Cached multi-head attention for one block.  ``h`` (S, T, D) are the
+    post-LN hiddens of the T NEW tokens at absolute positions ``pos_ids``
+    (S, T); k/v for those tokens are written into the (S, H, Tmax, Dh)
+    caches and the queries attend over the caches with a causal cut at
+    each query's absolute position.  Returns (out, new_k, new_v)."""
+    q = h @ ap["wq"]
+    k = h @ ap["wk"]
+    v = h @ ap["wv"]
+    if mha.with_bias:
+        q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    S, T, _ = h.shape
+    H, Dh = mha.num_heads, mha.head_dim
+
+    def split(x):
+        return x.reshape(S, T, H, Dh).transpose(0, 2, 1, 3)  # (S,H,T,Dh)
+
+    q, k, v = split(q), split(k), split(v)
+    # write the T new tokens at pos_ids[:, 0] .. pos_ids[:, 0]+T-1
+    # (positions within one call are consecutive by construction)
+    start = pos_ids[:, 0]
+
+    def write(cache_s, kv_s, s0):
+        return jax.lax.dynamic_update_slice(cache_s, kv_s, (0, s0, 0))
+
+    new_k = jax.vmap(write)(k_cache, k, start)
+    new_v = jax.vmap(write)(v_cache, v, start)
+    scale = 1.0 / (Dh ** 0.5)
+    scores = jnp.einsum("shqd,shkd->shqk", q, new_k) \
+        .astype(jnp.float32) * scale
+    # causal over ABSOLUTE positions: query at position p sees cache
+    # positions <= p; everything past the write head is garbage AND
+    # masked (ki > p for all valid queries)
+    ki = jnp.arange(new_k.shape[2])  # (Tmax,)
+    mask = ki[None, None, None, :] <= pos_ids[:, None, :, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1).astype(new_v.dtype)
+    o = jnp.einsum("shqk,shkd->shqd", w, new_v)
+    o = o.transpose(0, 2, 1, 3).reshape(S, T, H * Dh)
+    out = o @ ap["wo"]
+    if mha.with_bias:
+        out = out + ap["bo"]
+    return out, new_k, new_v
+
+
+def decode_forward(model, params, tokens, pos_ids, k_caches, v_caches):
+    """Cached forward of a :func:`transformer_lm`: the T tokens per slot
+    are NEW tokens at absolute positions ``pos_ids`` (S, T) — prefill
+    passes the whole prompt with positions 0..T-1 over empty caches, a
+    decode step passes one token at its write position.  Returns
+    ``(log_probs (S, T, V), new_k, new_v)`` with the new tokens' K/V
+    written into the caches.  Pure function of its arguments (state-free:
+    every transformer_lm layer is stateless)."""
+    embed, pos, blocks, final_ln, head, mha = lm_layout(model)
+    x, _ = embed.apply(params["0"], {}, tokens)
+    # positional row per token's absolute position (the full-context
+    # apply's [:T] slice is the pos_ids == arange(T) special case)
+    x = x + params["1"]["weight"][pos_ids].astype(x.dtype)
+    nk, nv = [], []
+    for i, block in enumerate(blocks):
+        bp = params[str(2 + i)]
+        attn_seq = block.modules[0].modules[0].modules[0]
+        mlp_seq = block.modules[1].modules[0].modules[0]
+        ap = bp["0"]["0"]["0"]   # {"0": LN, "1": MHA}
+        mp = bp["1"]["0"]["0"]   # {"0": LN, "1": Lin, "2": {}, "3": Lin}
+        h, _ = attn_seq.modules[0].apply(ap["0"], {}, x)
+        o, k_i, v_i = _block_attn(attn_seq.modules[1], ap["1"], h,
+                                  k_caches[i], v_caches[i], pos_ids)
+        x = x + o
+        h, _ = mlp_seq.modules[0].apply(mp["0"], {}, x)
+        h, _ = mlp_seq.modules[1].apply(mp["1"], {}, h)
+        h, _ = mlp_seq.modules[2].apply(mp["2"], {}, h)
+        h, _ = mlp_seq.modules[3].apply(mp["3"], {}, h)
+        x = x + h
+        nk.append(k_i)
+        nv.append(v_i)
+    x, _ = final_ln.apply(params[str(2 + len(blocks))], {}, x)
+    x, _ = head.apply(params[str(3 + len(blocks))], {}, x)
+    lp = jax.nn.log_softmax(x, axis=-1)
+    return lp, jnp.stack(nk), jnp.stack(nv)
+
+
+def transformer_lm_prefill(model, params, tokens):
+    """Prefill ``tokens`` (S, T) from position 0: returns
+    ``(log_probs (S, T, V), k, v)`` with caches sized (L, S, H, T, Dh) —
+    exactly the prompt's K/V, ready to be spliced into a serving cache.
+    Rows padded past their true length produce garbage log-probs and
+    garbage cache ENTRIES at the padded positions; both are benign (the
+    caller reads the last VALID position's logits, and decode overwrites
+    pad positions before ever attending them)."""
+    S, T = tokens.shape
+    pos_ids = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :],
+                               (S, T))
+    k0, v0 = init_kv_cache(model, S, T)
+    return decode_forward(model, params, tokens, pos_ids, k0, v0)
+
+
+def transformer_lm_decode_step(model, params, tokens, lengths,
+                               k_caches, v_caches):
+    """One decode step over a slot batch: ``tokens`` (S,) are the last
+    emitted token per slot, ``lengths`` (S,) the number of cached
+    positions per slot.  Writes each token's K/V at position
+    ``lengths[s]`` and returns ``(log_probs (S, V), new_k, new_v)`` —
+    the next-token distribution per slot.  Inactive slots compute
+    garbage that the caller discards; their writes land at their stale
+    write head and are overwritten by the next prefill into that
+    slot."""
+    pos_ids = lengths.astype(jnp.int32)[:, None]  # (S, 1)
+    lp, nk, nv = decode_forward(model, params, tokens[:, None], pos_ids,
+                                k_caches, v_caches)
+    return lp[:, 0], nk, nv

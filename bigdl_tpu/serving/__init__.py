@@ -24,19 +24,32 @@ reference repo's ``PredictionService.scala`` — whose Python twin in
     reg = ModelRegistry()
     reg.deploy("textclf", model, input_spec=..., quantize=True)
     reg.predict("textclf", x)      # newest version
+
+Big-model + autoregressive serving (ROADMAP item 1's sharded half):
+
+    from bigdl_tpu.serving import ShardedReplicaSet
+    rs = ShardedReplicaSet(model, devices_per_replica=4)  # mesh slices
+
+    from bigdl_tpu.serving import DecodeService
+    dec = DecodeService(lm, slots=8, max_seq_len=256, eos_id=2)
+    res = dec.generate([5, 17, 3], max_new_tokens=16)  # DecodeResult
 """
 
 from bigdl_tpu.serving.batcher import (
     DeadlineExceeded, RequestBatcher, RequestSpecError, ServiceClosed,
     ServiceOverloaded,
 )
+from bigdl_tpu.serving.decode import DecodeResult, DecodeService
 from bigdl_tpu.serving.metrics import LatencyReservoir, ServingMetrics
 from bigdl_tpu.serving.registry import ModelRegistry
-from bigdl_tpu.serving.service import InferenceService, pad_rows, row_buckets
+from bigdl_tpu.serving.service import (InferenceService, pad_rows,
+                                       parse_row_buckets, row_buckets)
+from bigdl_tpu.serving.sharded import ShardedReplicaSet
 
 __all__ = [
     "InferenceService", "ModelRegistry", "RequestBatcher",
     "ServiceClosed", "ServiceOverloaded", "DeadlineExceeded",
     "RequestSpecError", "ServingMetrics", "LatencyReservoir",
-    "row_buckets",
+    "row_buckets", "parse_row_buckets",
+    "ShardedReplicaSet", "DecodeService", "DecodeResult",
 ]

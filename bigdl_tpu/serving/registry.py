@@ -125,11 +125,18 @@ class ModelRegistry:
                weights: Optional[str] = None,
                tf_inputs: Optional[List[str]] = None,
                tf_outputs: Optional[List[str]] = None,
-               **service_kw) -> InferenceService:
+               service=None, **service_kw) -> InferenceService:
         """Deploy ``model`` (or load one from ``path``/``format``) as
         ``name``:``version``.  ``service_kw`` flows to
         :class:`InferenceService` (``input_spec`` for deploy-time AOT
         warmup, batching/backpressure knobs, ``start=False``...).
+
+        ``service=``: register an ALREADY-CONSTRUCTED submit()-shaped
+        backend (e.g. a :class:`~bigdl_tpu.serving.DecodeService`)
+        under latest-wins + breaker routing instead of building an
+        :class:`InferenceService` — hot cutover and undeploy work
+        unchanged (they only need ``stop(drain=)``).  Mutually
+        exclusive with ``model``/``path``/``service_kw``.
 
         ``quantize``: False (default) deploys as-is; True int8-quantizes
         on the way in with the ``Config.int8_activation_mode`` default;
@@ -138,7 +145,12 @@ class ModelRegistry:
         version with its own circuit breaker and a ``weights_dtype``
         stats tag — latest-wins routing plus the breaker gate rollback
         to the float incumbent if the int8 version misbehaves."""
-        if model is None:
+        if service is not None:
+            if model is not None or path is not None or service_kw:
+                raise ValueError(
+                    "deploy(service=) takes a prebuilt backend — "
+                    "model/path/service_kw don't apply")
+        elif model is None:
             if path is None or format is None:
                 raise ValueError("deploy() needs model= or path=+format=")
             model = _load_model(format, path, prototxt=prototxt,
@@ -164,14 +176,15 @@ class ModelRegistry:
                     f"model {name!r} version {version} already deployed; "
                     "undeploy it first or bump the version")
             self._pending.add(key)  # acquires: deploy_reservation
-        try:
-            service = InferenceService(
-                model, params, state, name=f"{name}:v{version}",
-                **service_kw)
-        except BaseException:
-            with self._lock:
-                self._pending.discard(key)  # releases: deploy_reservation
-            raise
+        if service is None:
+            try:
+                service = InferenceService(
+                    model, params, state, name=f"{name}:v{version}",
+                    **service_kw)
+            except BaseException:
+                with self._lock:
+                    self._pending.discard(key)  # releases: deploy_reservation
+                raise
         with self._lock:
             self._pending.discard(key)  # releases: deploy_reservation
             self._services[key] = service

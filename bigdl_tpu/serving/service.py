@@ -44,12 +44,14 @@ from bigdl_tpu.serving.metrics import ServingMetrics
 _tree = jax.tree_util
 
 
-def row_buckets(max_batch_size: int) -> Tuple[int, ...]:
+def row_buckets(max_batch_size: int, floor: int = 1) -> Tuple[int, ...]:
     """Power-of-two row buckets up to ``max_batch_size`` (inclusive —
     a non-power-of-two max becomes the top bucket so a full coalesced
-    batch never spills into two dispatches)."""
+    batch never spills into two dispatches).  ``floor`` starts the
+    ladder higher than 1 — sequence-length ladders (decode prefill)
+    have no use for 1/2/4-token executables."""
     bs = []
-    b = 1
+    b = max(1, int(floor))
     while b < max_batch_size:
         bs.append(b)
         b *= 2
@@ -65,6 +67,10 @@ def parse_row_buckets(spec: str, max_batch_size: int) -> Tuple[int, ...]:
     - ``"top"`` — one bucket at ``max_batch_size`` (maximum executable
       sharing, maximum padding — the autotuner's coarse-granularity
       grid point);
+    - ``"pow2@16"`` — power-of-two ladder FLOORED at 16: the
+      sequence-length form of the grammar (decode prefill buckets in
+      ``serving/decode.py``, where ``max_batch_size`` is the max
+      prompt length and sub-floor executables are wasted compiles);
     - ``"8,16,32"`` — explicit ascending positive ints whose top must
       cover ``max_batch_size`` (a full coalesced batch always has a
       bucket to pad into).
@@ -74,6 +80,16 @@ def parse_row_buckets(spec: str, max_batch_size: int) -> Tuple[int, ...]:
         return row_buckets(max_batch_size)
     if s == "top":
         return (max_batch_size,)
+    if s.startswith("pow2@"):
+        try:
+            floor = int(s[5:])
+        except ValueError:
+            raise ValueError(
+                f"bucket spec {spec!r}: pow2@<floor> needs an int "
+                f"floor") from None
+        if floor < 1:
+            raise ValueError(f"bucket floor must be >= 1: {floor}")
+        return row_buckets(max_batch_size, floor)
     try:
         buckets = tuple(int(tok) for tok in s.split(","))
     except ValueError:

@@ -216,6 +216,12 @@ class Config:
     # clients of fds.  0 = never reap.  Env:
     # BIGDL_TPU_FRONTEND_IDLE_TIMEOUT_S.
     frontend_idle_timeout_s: float = 120.0
+    # pin each event-loop shard thread to one CPU
+    # (os.sched_setaffinity, loop i → available cpu i mod count) so
+    # shards stop migrating across cores under load (cache/IRQ
+    # locality).  Silently inert on platforms without sched_setaffinity
+    # (macOS, Windows).  Env: BIGDL_TPU_FRONTEND_PIN_CPUS.
+    frontend_pin_cpus: bool = False
     # lockdep (utils/lockdep.py): TSan-lite lock-order sanitizer for
     # the threaded host plane.  False (default) = provably inert — no
     # wrapper object is ever allocated, threading.Lock/RLock stay the

@@ -430,22 +430,25 @@ def lint_paths(paths: Sequence[str],
     return LintResult(violations, len(files))
 
 
-def lint_paths_stats(paths: Sequence[str],
-                     select: Optional[Sequence[str]] = None) -> dict:
-    """Per-rule finding/suppression counts across the tree — the
-    suppression-debt dashboard behind ``--stats``.  Returns
-    ``{"files_scanned": n, "rules": {id: {"name", "findings",
-    "suppressed"}}}`` with a row for every registered rule (zeros
-    included: debt you don't have is part of the dashboard)."""
+def lint_paths_with_stats(
+        paths: Sequence[str],
+        select: Optional[Sequence[str]] = None) -> "Tuple[LintResult, dict]":
+    """One scan, both artifacts: the gate's :class:`LintResult` AND
+    the suppression-debt stats dict (same schema as
+    :func:`lint_paths_stats`).  Whole-tree callers — the CI gate, the
+    real-tree test suite — need both views and shouldn't pay the
+    parse twice."""
     rules = {r.id: {"name": r.name, "findings": 0, "suppressed": 0}
              for r in all_rules()
              if not select or _selected(r, select)}
     by_file: Dict[str, Dict[str, int]] = {}
+    violations: List[Violation] = []
     files = list(iter_python_files(paths))
     for f in files:
         with open(f, "r", encoding="utf-8") as fh:
             kept, suppressed = _lint_source_full(fh.read(), path=f,
                                                  select=select)
+        violations.extend(kept)
         for v in kept:
             rules.setdefault(v.rule, {"name": v.name, "findings": 0,
                                       "suppressed": 0})["findings"] += 1
@@ -457,6 +460,7 @@ def lint_paths_stats(paths: Sequence[str],
     # _mechanism_ledger_full) — its findings are GL401 debt like any
     # other, so the dashboard and the gate must agree on them
     ledger_kept, ledger_sup = _mechanism_ledger_full(files, select)
+    violations.extend(ledger_kept)
     for v in ledger_kept:
         rules.setdefault(v.rule, {"name": v.name, "findings": 0,
                                   "suppressed": 0})["findings"] += 1
@@ -464,9 +468,21 @@ def lint_paths_stats(paths: Sequence[str],
         rules[v.rule]["suppressed"] += 1
         row = by_file.setdefault(_relpath(v.path), {})
         row[v.rule] = row.get(v.rule, 0) + 1
-    return {"files_scanned": len(files), "rules": rules,
-            "suppressions_by_file": {p: dict(sorted(r.items()))
-                                     for p, r in sorted(by_file.items())}}
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    stats = {"files_scanned": len(files), "rules": rules,
+             "suppressions_by_file": {p: dict(sorted(r.items()))
+                                      for p, r in sorted(by_file.items())}}
+    return LintResult(violations, len(files)), stats
+
+
+def lint_paths_stats(paths: Sequence[str],
+                     select: Optional[Sequence[str]] = None) -> dict:
+    """Per-rule finding/suppression counts across the tree — the
+    suppression-debt dashboard behind ``--stats``.  Returns
+    ``{"files_scanned": n, "rules": {id: {"name", "findings",
+    "suppressed"}}}`` with a row for every registered rule (zeros
+    included: debt you don't have is part of the dashboard)."""
+    return lint_paths_with_stats(paths, select=select)[1]
 
 
 _RELPATH_ROOT: List[Optional[str]] = [None]  # memo: one git call per run
